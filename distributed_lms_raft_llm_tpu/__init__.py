@@ -1,0 +1,25 @@
+"""TPU-native distributed LMS framework.
+
+Capabilities mirror `naggender2/distributed-lms-raft-llm` (see SURVEY.md):
+a Raft-replicated LMS control plane plus an LLM tutoring path — rebuilt
+TPU-first. All ML compute (GPT-2 generation, BERT relevance embedding) runs
+as jitted, mesh-sharded JAX/XLA programs; the control plane (Raft, LMS state
+machine, file replication, serving, clients) is clean asyncio Python speaking
+the frozen `lms.proto` gRPC contract.
+
+Subpackages
+-----------
+- ``proto``    — frozen wire contract, generated messages, RPC glue
+- ``models``   — functional JAX models (GPT-2, BERT, Llama) as param pytrees
+- ``ops``      — Pallas TPU kernels and sampling ops
+- ``parallel`` — mesh construction, partition rules, ring attention, collectives
+- ``engine``   — inference runtime: KV cache, prefill/decode, batching, gate
+- ``train``    — sharded training step (loss, optimizer, TrainState)
+- ``raft``     — sans-IO Raft core + storage + gRPC/in-memory transports
+- ``lms``      — LMS state machine, appliers, persistence, file replication
+- ``serving``  — server entrypoints (lms_server, tutoring_server)
+- ``client``   — leader-discovering client library + CLI
+- ``utils``    — config, logging, metrics, tokenizer
+"""
+
+__version__ = "0.1.0"

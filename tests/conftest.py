@@ -1,0 +1,29 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so that every sharding / collective
+path (tp/dp/sp ring attention, pjit train step) is exercised without TPU
+hardware. These env vars must be set before JAX initializes its backends,
+hence at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep test-time compiles cheap and deterministic.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
+    return devices[:8]

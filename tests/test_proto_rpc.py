@@ -1,0 +1,117 @@
+"""Wire-contract smoke tests: message round-trips and a live gRPC exchange."""
+
+import concurrent.futures
+
+import grpc
+import pytest
+
+from distributed_lms_raft_llm_tpu.proto import lms_pb2, rpc
+
+
+def test_message_roundtrip():
+    req = lms_pb2.AppendEntriesRequest(
+        leader=lms_pb2.TermLeaderIDPair(leaderID=2, term=5),
+        prevLogIndex=3,
+        prevLogTerm=4,
+        entries=[lms_pb2.LogEntry(term=5, command='{"operation":"Register"}')],
+        leaderCommit=3,
+    )
+    out = lms_pb2.AppendEntriesRequest.FromString(req.SerializeToString())
+    assert out.leader.leaderID == 2 and out.leader.term == 5
+    assert out.entries[0].command == '{"operation":"Register"}'
+
+
+def test_frozen_contract_method_names():
+    # The frozen contract (reference GUI_RAFT_LLM_SourceCode/lms.proto:106-142):
+    # exact service and method names — a rename breaks every existing client.
+    services = lms_pb2.DESCRIPTOR.services_by_name
+    assert sorted(services) == [
+        "FileTransferService",
+        "LMS",
+        "RaftService",
+        "Tutoring",
+    ]
+    assert sorted(m.name for m in services["LMS"].methods) == sorted(
+        [
+            "Register",
+            "Login",
+            "Logout",
+            "Post",
+            "Get",
+            "GradeAssignment",
+            "GetGrade",
+            "GetLLMAnswer",
+            "GetUnansweredQueries",
+            "RespondToQuery",
+            "GetInstructorResponse",
+            "WhoIsLeader",
+        ]
+    )
+    assert [m.name for m in services["Tutoring"].methods] == ["GetLLMAnswer"]
+    assert sorted(m.name for m in services["RaftService"].methods) == sorted(
+        ["RequestVote", "AppendEntries", "SetVal", "GetVal", "GetLeader", "WhoIsLeader"]
+    )
+    assert sorted(m.name for m in services["FileTransferService"].methods) == sorted(
+        ["SendFile", "ReplicateData"]
+    )
+    # Stream-unary only for SendFile.
+    assert services["FileTransferService"].methods_by_name["SendFile"].client_streaming
+    assert rpc._SERVICES["FileTransferService"]["SendFile"][2] == "su"
+
+
+class _Raft(rpc.RaftServiceServicer):
+    def WhoIsLeader(self, request, context):
+        return lms_pb2.LeaderResponse(leader_id=3)
+
+
+class _Files(rpc.FileTransferServiceServicer):
+    def SendFile(self, request_iterator, context):
+        total = sum(len(chunk.content) for chunk in request_iterator)
+        return lms_pb2.FileTransferResponse(status=f"success:{total}")
+
+
+@pytest.fixture()
+def live_server():
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=4))
+    rpc.add_RaftServiceServicer_to_server(_Raft(), server)
+    rpc.add_FileTransferServiceServicer_to_server(_Files(), server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield f"127.0.0.1:{port}"
+    server.stop(grace=None)
+
+
+def test_unary_rpc_over_wire(live_server):
+    with grpc.insecure_channel(live_server) as channel:
+        stub = rpc.RaftServiceStub(channel)
+        resp = stub.WhoIsLeader(lms_pb2.Empty(), timeout=5)
+        assert resp.leader_id == 3
+
+
+def test_stream_unary_rpc_over_wire(live_server):
+    with grpc.insecure_channel(live_server) as channel:
+        stub = rpc.FileTransferServiceStub(channel)
+        chunks = (
+            lms_pb2.FileChunk(content=b"x" * 10, destination_path="uploads/a.pdf")
+            for _ in range(3)
+        )
+        resp = stub.SendFile(chunks, timeout=5)
+        assert resp.status == "success:30"
+
+
+def test_unimplemented_method_raises():
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=1))
+    rpc.add_TutoringServicer_to_server(rpc.TutoringServicer(), server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stub = rpc.TutoringStub(channel)
+            with pytest.raises(grpc.RpcError) as e:
+                stub.GetLLMAnswer(lms_pb2.QueryRequest(query="q"), timeout=5)
+            assert e.value.code() in (
+                grpc.StatusCode.UNIMPLEMENTED,
+                grpc.StatusCode.UNKNOWN,
+            )
+    finally:
+        server.stop(grace=None)
