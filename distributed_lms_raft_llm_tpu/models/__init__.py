@@ -1,0 +1,4 @@
+"""Functional JAX model zoo (param pytrees + pure forward functions)."""
+
+from . import bert, common, convert, gpt2  # noqa: F401
+from .common import KVCache  # noqa: F401

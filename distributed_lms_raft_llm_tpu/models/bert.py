@@ -1,0 +1,165 @@
+"""BERT encoder as a pure-functional JAX model.
+
+Capability parity target: the reference relevance gate runs HF
+`BertModel("bert-base-uncased")` and mean-pools `last_hidden_state`
+(reference: GUI_RAFT_LLM_SourceCode/lms_server.py:97-101, 1258-1263) — and
+reloads the model on every request (defect D4). Here the encoder is a jitted
+pytree function loaded once; `embed` reproduces the mean-pool semantics (with
+a padding-aware mean, the batched generalization of the reference's
+unbatched mean over all 512 truncated positions).
+
+Same TPU-first layout as gpt2.py: stacked layers + `lax.scan`, fused QKV.
+BERT is post-LN and uses exact (erf) GELU — both differ from GPT-2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import attend, dense, layer_norm, merge_heads, split_heads
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return 4 * self.hidden_size
+
+    @classmethod
+    def base_uncased(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("max_position_embeddings", 64)
+        return cls(hidden_size=32, num_layers=2, num_heads=4, **kw)
+
+
+def init_params(rng: jax.Array, cfg: BertConfig) -> Params:
+    d, l, m = cfg.hidden_size, cfg.num_layers, cfg.mlp_dim
+    keys = jax.random.split(rng, 7)
+    std = 0.02
+    pd = cfg.param_dtype
+
+    def norm(key, shape):
+        return (std * jax.random.normal(key, shape)).astype(pd)
+
+    def ln(shape=(l, d)):
+        return {"scale": jnp.ones(shape, pd), "bias": jnp.zeros(shape, pd)}
+
+    return {
+        "embeddings": {
+            "word": norm(keys[0], (cfg.vocab_size, d)),
+            "position": norm(keys[1], (cfg.max_position_embeddings, d)),
+            "token_type": norm(keys[2], (cfg.type_vocab_size, d)),
+            "ln": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+        },
+        "blocks": {
+            "attn": {
+                "wqkv": norm(keys[3], (l, d, 3 * d)),
+                "bqkv": jnp.zeros((l, 3 * d), pd),
+                "wo": norm(keys[4], (l, d, d)),
+                "bo": jnp.zeros((l, d), pd),
+            },
+            "attn_ln": ln(),
+            "mlp": {
+                "wi": norm(keys[5], (l, d, m)),
+                "bi": jnp.zeros((l, m), pd),
+                "wo": norm(keys[6], (l, m, d)),
+                "bo": jnp.zeros((l, d), pd),
+            },
+            "mlp_ln": ln(),
+        },
+    }
+
+
+def forward(
+    params: Params,
+    cfg: BertConfig,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Encode; returns last_hidden_state [B, T, D] in the compute dtype."""
+    b, t = input_ids.shape
+    eps = cfg.layer_norm_eps
+    num_heads = cfg.num_heads
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, t), jnp.bool_)
+    attention_mask = attention_mask.astype(jnp.bool_)
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros((b, t), jnp.int32)
+
+    emb = params["embeddings"]
+    x = (
+        emb["word"][input_ids]
+        + emb["position"][jnp.arange(t)][None, :, :]
+        + emb["token_type"][token_type_ids]
+    )
+    x = layer_norm(x, emb["ln"]["scale"], emb["ln"]["bias"], eps).astype(cfg.dtype)
+
+    # Bidirectional: every query sees every non-pad key.
+    mask = attention_mask[:, None, None, :]
+
+    def body(x, lp):
+        qkv = dense(x, lp["attn"]["wqkv"], lp["attn"]["bqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        a = attend(split_heads(q, num_heads), split_heads(k, num_heads),
+                   split_heads(v, num_heads), mask)
+        a = dense(merge_heads(a), lp["attn"]["wo"], lp["attn"]["bo"])
+        x = layer_norm(x + a, lp["attn_ln"]["scale"], lp["attn_ln"]["bias"], eps)
+        m = dense(x, lp["mlp"]["wi"], lp["mlp"]["bi"])
+        m = jax.nn.gelu(m, approximate=False)  # BERT uses exact erf GELU
+        m = dense(m, lp["mlp"]["wo"], lp["mlp"]["bo"])
+        x = layer_norm(x + m, lp["mlp_ln"]["scale"], lp["mlp_ln"]["bias"], eps)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return x
+
+
+def embed(
+    params: Params,
+    cfg: BertConfig,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mean-pooled sentence embeddings [B, D] float32 (the relevance-gate op)."""
+    hidden = forward(params, cfg, input_ids, attention_mask, token_type_ids)
+    hidden = hidden.astype(jnp.float32)
+    if attention_mask is None:
+        return jnp.mean(hidden, axis=1)
+    w = attention_mask.astype(jnp.float32)
+    total = jnp.einsum("btd,bt->bd", hidden, w)
+    return total / jnp.maximum(jnp.sum(w, axis=1, keepdims=True), 1.0)
+
+
+def cosine_similarity(a: jax.Array, b: jax.Array, axis: int = -1) -> jax.Array:
+    """Cosine similarity (the reference gate compares against 0.6)."""
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    num = jnp.sum(a * b, axis=axis)
+    denom = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+    return num / jnp.maximum(denom, 1e-12)

@@ -1,0 +1,193 @@
+"""HF-checkpoint → JAX pytree conversion (torch-free at runtime).
+
+The reference pulls `gpt2` / `bert-base-uncased` from the HF hub through
+PyTorch (reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:10-12,
+lms_server.py:10-12). Here conversion is a plain dict transform over numpy
+arrays, so serving never imports torch: feed it a state dict obtained from a
+`.safetensors` file (preferred) or, in tests, from a torch model's
+`state_dict()` converted to numpy.
+
+Shape conventions of the target pytrees are defined in gpt2.py / bert.py:
+per-layer tensors stacked on a leading layer axis, linear weights [in, out].
+HF GPT-2 uses Conv1D ([in, out] already — no transpose); HF BERT uses
+torch Linear ([out, in] — transposed here).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from .bert import BertConfig
+from .gpt2 import GPT2Config
+
+StateDict = Mapping[str, np.ndarray]
+
+
+def _np(x) -> np.ndarray:
+    """Coerce torch tensors / jax arrays / numpy to numpy without importing torch."""
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _strip_prefix(sd: StateDict, prefix: str) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in sd.items():
+        out[k[len(prefix):] if k.startswith(prefix) else k] = v
+    return out
+
+
+def gpt2_config_from_hf(hf_config: Mapping[str, Any], **kw) -> GPT2Config:
+    return GPT2Config(
+        vocab_size=hf_config["vocab_size"],
+        max_position_embeddings=hf_config.get("n_positions", 1024),
+        hidden_size=hf_config["n_embd"],
+        num_layers=hf_config["n_layer"],
+        num_heads=hf_config["n_head"],
+        layer_norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+        **kw,
+    )
+
+
+def gpt2_params_from_hf(sd: StateDict, cfg: GPT2Config) -> Dict[str, Any]:
+    """Map HF GPT2LMHeadModel / GPT2Model weights onto the gpt2.py pytree."""
+    sd = _strip_prefix({k: _np(v) for k, v in sd.items()}, "transformer.")
+    L = cfg.num_layers
+    pd = cfg.param_dtype
+
+    def stack(fmt: str) -> np.ndarray:
+        return np.stack([sd[fmt.format(i)] for i in range(L)]).astype(pd)
+
+    return {
+        "wte": sd["wte.weight"].astype(pd),
+        "wpe": sd["wpe.weight"].astype(pd),
+        "blocks": {
+            "ln1": {
+                "scale": stack("h.{}.ln_1.weight"),
+                "bias": stack("h.{}.ln_1.bias"),
+            },
+            "attn": {
+                # HF Conv1D stores [in, out]: use as-is.
+                "wqkv": stack("h.{}.attn.c_attn.weight"),
+                "bqkv": stack("h.{}.attn.c_attn.bias"),
+                "wo": stack("h.{}.attn.c_proj.weight"),
+                "bo": stack("h.{}.attn.c_proj.bias"),
+            },
+            "ln2": {
+                "scale": stack("h.{}.ln_2.weight"),
+                "bias": stack("h.{}.ln_2.bias"),
+            },
+            "mlp": {
+                "wi": stack("h.{}.mlp.c_fc.weight"),
+                "bi": stack("h.{}.mlp.c_fc.bias"),
+                "wo": stack("h.{}.mlp.c_proj.weight"),
+                "bo": stack("h.{}.mlp.c_proj.bias"),
+            },
+        },
+        "lnf": {
+            "scale": sd["ln_f.weight"].astype(pd),
+            "bias": sd["ln_f.bias"].astype(pd),
+        },
+    }
+
+
+def bert_config_from_hf(hf_config: Mapping[str, Any], **kw) -> BertConfig:
+    return BertConfig(
+        vocab_size=hf_config["vocab_size"],
+        max_position_embeddings=hf_config["max_position_embeddings"],
+        type_vocab_size=hf_config.get("type_vocab_size", 2),
+        hidden_size=hf_config["hidden_size"],
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        layer_norm_eps=hf_config.get("layer_norm_eps", 1e-12),
+        **kw,
+    )
+
+
+def bert_params_from_hf(sd: StateDict, cfg: BertConfig) -> Dict[str, Any]:
+    """Map HF BertModel weights onto the bert.py pytree (pooler ignored)."""
+    sd = _strip_prefix({k: _np(v) for k, v in sd.items()}, "bert.")
+    L = cfg.num_layers
+    pd = cfg.param_dtype
+
+    def lin_w(fmt: str) -> np.ndarray:
+        # torch Linear stores [out, in]; our dense expects [in, out].
+        return np.stack([sd[fmt.format(i)].T for i in range(L)]).astype(pd)
+
+    def vec(fmt: str) -> np.ndarray:
+        return np.stack([sd[fmt.format(i)] for i in range(L)]).astype(pd)
+
+    p = "encoder.layer.{}.attention.self."
+    wq, wk, wv = (lin_w(p + n + ".weight") for n in ("query", "key", "value"))
+    bq, bk, bv = (vec(p + n + ".bias") for n in ("query", "key", "value"))
+
+    return {
+        "embeddings": {
+            "word": sd["embeddings.word_embeddings.weight"].astype(pd),
+            "position": sd["embeddings.position_embeddings.weight"].astype(pd),
+            "token_type": sd["embeddings.token_type_embeddings.weight"].astype(pd),
+            "ln": {
+                "scale": sd["embeddings.LayerNorm.weight"].astype(pd),
+                "bias": sd["embeddings.LayerNorm.bias"].astype(pd),
+            },
+        },
+        "blocks": {
+            "attn": {
+                "wqkv": np.concatenate([wq, wk, wv], axis=-1),
+                "bqkv": np.concatenate([bq, bk, bv], axis=-1),
+                "wo": lin_w("encoder.layer.{}.attention.output.dense.weight"),
+                "bo": vec("encoder.layer.{}.attention.output.dense.bias"),
+            },
+            "attn_ln": {
+                "scale": vec("encoder.layer.{}.attention.output.LayerNorm.weight"),
+                "bias": vec("encoder.layer.{}.attention.output.LayerNorm.bias"),
+            },
+            "mlp": {
+                "wi": lin_w("encoder.layer.{}.intermediate.dense.weight"),
+                "bi": vec("encoder.layer.{}.intermediate.dense.bias"),
+                "wo": lin_w("encoder.layer.{}.output.dense.weight"),
+                "bo": vec("encoder.layer.{}.output.dense.bias"),
+            },
+            "mlp_ln": {
+                "scale": vec("encoder.layer.{}.output.LayerNorm.weight"),
+                "bias": vec("encoder.layer.{}.output.LayerNorm.bias"),
+            },
+        },
+    }
+
+
+def load_safetensors(path: str) -> Dict[str, np.ndarray]:
+    """Read a .safetensors file into numpy arrays (no torch).
+
+    Minimal reader for the standard format: 8-byte little-endian header
+    length, JSON header {name: {dtype, shape, data_offsets}}, raw buffer.
+    """
+    import json
+    import struct
+
+    dtype_map = {
+        "F64": np.float64, "F32": np.float32, "F16": np.float16,
+        "BF16": None,  # handled below
+        "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+        "U8": np.uint8, "BOOL": np.bool_,
+    }
+    out: Dict[str, np.ndarray] = {}
+    with open(path, "rb") as f:
+        (header_len,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(header_len))
+        buf = f.read()
+    for name, spec in header.items():
+        if name == "__metadata__":
+            continue
+        start, end = spec["data_offsets"]
+        raw = buf[start:end]
+        if spec["dtype"] == "BF16":
+            # bfloat16: upcast via zero-extended uint16 -> uint32 -> float32.
+            u16 = np.frombuffer(raw, np.uint16).astype(np.uint32) << 16
+            arr = u16.view(np.float32)
+        else:
+            arr = np.frombuffer(raw, dtype_map[spec["dtype"]])
+        out[name] = arr.reshape(spec["shape"])
+    return out

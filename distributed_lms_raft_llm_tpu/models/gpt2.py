@@ -1,0 +1,219 @@
+"""GPT-2 as a pure-functional JAX model (TPU-first rewrite).
+
+Capability parity target: the reference tutoring backend loads HF
+`GPT2LMHeadModel` and calls `.generate` through PyTorch
+(reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:10-12, 21-29). Here
+the model is a jitted function over a parameter pytree; generation lives in
+`engine.generate` (KV-cache decode under `lax.while_loop`), and weights come
+from `models.convert.gpt2_params_from_hf` without any torch dependency.
+
+Layout notes (TPU-first):
+- All per-layer weights are stacked on a leading layer axis and the trunk is
+  one `lax.scan` — O(1) compile time in depth.
+- QKV is a single fused [D, 3D] matmul feeding the MXU.
+- Attention runs against a static-size KV window (`common.KVCache`) so the
+  decode step has fixed shapes for XLA.
+- Sequence slots are used for causality (left-padding friendly); learned
+  position embeddings are indexed by an explicit per-row `positions` array.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    KVCache,
+    attend,
+    causal_window_mask,
+    dense,
+    layer_norm,
+    merge_heads,
+    split_heads,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_position_embeddings: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32  # compute dtype; bfloat16 on TPU
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return 4 * self.hidden_size
+
+    # Published GPT-2 family sizes (124M/355M/774M/1.5B).
+    @classmethod
+    def small(cls, **kw) -> "GPT2Config":
+        return cls(**kw)
+
+    @classmethod
+    def medium(cls, **kw) -> "GPT2Config":
+        return cls(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+    @classmethod
+    def large(cls, **kw) -> "GPT2Config":
+        return cls(hidden_size=1280, num_layers=36, num_heads=20, **kw)
+
+    @classmethod
+    def xl(cls, **kw) -> "GPT2Config":
+        return cls(hidden_size=1600, num_layers=48, num_heads=25, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        """Test-size config (fast CPU golden tests vs HF)."""
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("max_position_embeddings", 64)
+        return cls(hidden_size=32, num_layers=2, num_heads=4, **kw)
+
+
+def init_params(rng: jax.Array, cfg: GPT2Config) -> Params:
+    """Random init matching GPT-2's scheme (normal 0.02, scaled residual proj)."""
+    d, l, m = cfg.hidden_size, cfg.num_layers, cfg.mlp_dim
+    keys = jax.random.split(rng, 6)
+    std = 0.02
+    proj_std = std / jnp.sqrt(2.0 * l)
+    pd = cfg.param_dtype
+
+    def norm(key, shape, s):
+        return (s * jax.random.normal(key, shape)).astype(pd)
+
+    return {
+        "wte": norm(keys[0], (cfg.vocab_size, d), std),
+        "wpe": norm(keys[1], (cfg.max_position_embeddings, d), std),
+        "blocks": {
+            "ln1": {"scale": jnp.ones((l, d), pd), "bias": jnp.zeros((l, d), pd)},
+            "attn": {
+                "wqkv": norm(keys[2], (l, d, 3 * d), std),
+                "bqkv": jnp.zeros((l, 3 * d), pd),
+                "wo": norm(keys[3], (l, d, d), proj_std),
+                "bo": jnp.zeros((l, d), pd),
+            },
+            "ln2": {"scale": jnp.ones((l, d), pd), "bias": jnp.zeros((l, d), pd)},
+            "mlp": {
+                "wi": norm(keys[4], (l, d, m), std),
+                "bi": jnp.zeros((l, m), pd),
+                "wo": norm(keys[5], (l, m, d), proj_std),
+                "bo": jnp.zeros((l, d), pd),
+            },
+        },
+        "lnf": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+    }
+
+
+def init_cache(cfg: GPT2Config, batch: int, max_len: int, dtype=None) -> KVCache:
+    return KVCache.create(
+        cfg.num_layers, batch, cfg.num_heads, max_len, cfg.head_dim, dtype or cfg.dtype
+    )
+
+
+def forward(
+    params: Params,
+    cfg: GPT2Config,
+    input_ids: jax.Array,
+    cache: Optional[KVCache] = None,
+    positions: Optional[jax.Array] = None,
+    kv_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[KVCache]]:
+    """Run the transformer; returns (logits [B, T, V] float32, updated cache).
+
+    cache      — None for full-sequence (training / golden) mode; a KVCache
+                 for incremental prefill/decode. New keys are written at slot
+                 offset `cache.length`. PRECONDITION: callers must ensure
+                 `cache.length + T <= max_len` and positions stay below
+                 `max_position_embeddings` — JAX clamps out-of-bounds
+                 dynamic_update_slice/gather indices silently, which would
+                 corrupt the newest KV slots instead of raising. The engine
+                 enforces this (engine.generate caps max_new_tokens).
+    positions  — [B, T] indices into the learned position table. Defaults to
+                 slot indices (contiguous, no padding). The engine passes
+                 per-row positions when prompts are left-padded.
+    kv_mask    — [B, num_keys] validity of each key slot (False = padding).
+    """
+    b, t = input_ids.shape
+    eps = cfg.layer_norm_eps
+    num_heads = cfg.num_heads
+
+    offset = jnp.zeros((), jnp.int32) if cache is None else cache.length
+    q_slots = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    q_slots = jnp.broadcast_to(q_slots, (b, t))
+    if positions is None:
+        positions = q_slots
+
+    x = params["wte"][input_ids] + params["wpe"][positions]
+    x = x.astype(cfg.dtype)
+
+    num_keys = t if cache is None else cache.k.shape[3]
+    mask = causal_window_mask(q_slots, num_keys)  # [B, 1, T, num_keys]
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, None, :]
+
+    def block(x, layer_params, k_all, v_all):
+        lp = layer_params
+        h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], eps)
+        qkv = dense(h, lp["attn"]["wqkv"], lp["attn"]["bqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = split_heads(q, num_heads)
+        k_new = split_heads(k, num_heads)
+        v_new = split_heads(v, num_heads)
+        if k_all is None:
+            k_att, v_att = k_new, v_new
+        else:
+            zero = jnp.zeros((), offset.dtype)
+            start = (zero, zero, offset, zero)
+            k_all = jax.lax.dynamic_update_slice(
+                k_all, k_new.astype(k_all.dtype), start
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                v_all, v_new.astype(v_all.dtype), start
+            )
+            k_att, v_att = k_all.astype(q.dtype), v_all.astype(q.dtype)
+        a = attend(q, k_att, v_att, mask)
+        x = x + dense(merge_heads(a), lp["attn"]["wo"], lp["attn"]["bo"])
+        h2 = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], eps)
+        m = dense(h2, lp["mlp"]["wi"], lp["mlp"]["bi"])
+        m = jax.nn.gelu(m, approximate=True)  # GPT-2 uses the tanh approximation
+        x = x + dense(m, lp["mlp"]["wo"], lp["mlp"]["bo"])
+        return x, k_all, v_all
+
+    if cache is None:
+        def body(carry, lp):
+            y, _, _ = block(carry, lp, None, None)
+            return y, None
+
+        x, _ = jax.lax.scan(body, x, params["blocks"])
+        new_cache = None
+    else:
+        def body(carry, xs):
+            lp, k_l, v_l = xs
+            y, k_l, v_l = block(carry, lp, k_l, v_l)
+            return y, (k_l, v_l)
+
+        x, (new_k, new_v) = jax.lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + t)
+
+    x = layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"], eps)
+    # Tied unembedding (reference model ties lm_head to wte); f32 accumulation
+    # so sampling sees full-precision logits even in bfloat16 compute.
+    logits = jnp.einsum(
+        "btd,vd->btv",
+        x,
+        params["wte"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, new_cache
