@@ -1,0 +1,6 @@
+"""Inference runtime: sharded generation engine, sampling, batching, gate."""
+
+from .batcher import BatchingQueue  # noqa: F401
+from .engine import EngineConfig, TutoringEngine  # noqa: F401
+from .gate import GateConfig, RelevanceGate  # noqa: F401
+from .sampling import SamplingParams  # noqa: F401

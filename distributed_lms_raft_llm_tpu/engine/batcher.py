@@ -1,0 +1,110 @@
+"""Dynamic request batching for the tutoring engine.
+
+The wire contract is unary (`Tutoring.GetLLMAnswer`, one query per RPC —
+reference: GUI_RAFT_LLM_SourceCode/lms.proto:123-125), so batching must
+happen *inside* the server without changing the RPC (SURVEY.md §7 hard part
+3). Concurrent student queries are coalesced into device batches: a request
+waits at most `max_wait_ms` for companions, then the whole group runs as one
+sharded generate program (batch bucketed to powers of two in the engine).
+
+The reference handles concurrency with a 10-thread pool and sequential
+model.generate calls (tutoring_server.py:40) — throughput 1/latency. Here
+throughput scales with the batch bucket until the chip saturates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class BatchingQueue:
+    """Coalesces submit() calls into engine.answer_batch() invocations."""
+
+    def __init__(
+        self,
+        engine,
+        max_batch: int = 8,
+        max_wait_ms: float = 10.0,
+    ):
+        self.engine = engine
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1000.0
+        self._queue: asyncio.Queue[Tuple[str, asyncio.Future]] = asyncio.Queue()
+        self._runner: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def start(self) -> None:
+        if self._runner is None:
+            self._runner = asyncio.create_task(self._run())
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._runner is not None:
+            self._runner.cancel()
+            try:
+                await self._runner
+            except asyncio.CancelledError:
+                pass
+            self._runner = None
+        # Fail fast for anything still waiting (queued requests, or a group
+        # whose device batch was cancelled mid-flight) instead of hanging.
+        while not self._queue.empty():
+            _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(RuntimeError("batching queue closed"))
+
+    async def submit(self, prompt: str) -> str:
+        """Enqueue one query; resolves with its decoded answer."""
+        if self._closed:
+            raise RuntimeError("batching queue is closed")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((prompt, fut))
+        return await fut
+
+    async def _collect(self) -> List[Tuple[str, asyncio.Future]]:
+        """Block for the first request, then gather companions briefly."""
+        first = await self._queue.get()
+        group = [first]
+        deadline = time.monotonic() + self.max_wait_s
+        while len(group) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                item = await asyncio.wait_for(self._queue.get(), timeout=remaining)
+                group.append(item)
+            except asyncio.TimeoutError:
+                break
+        return group
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            group = await self._collect()
+            prompts = [p for p, _ in group]
+            try:
+                # The engine call blocks on device compute; run it off-loop so
+                # new requests keep queueing meanwhile.
+                answers = await loop.run_in_executor(
+                    None, self.engine.answer_batch, prompts
+                )
+            except asyncio.CancelledError:
+                # close() mid-batch: resolve the in-flight group before dying.
+                for _, fut in group:
+                    if not fut.done():
+                        fut.set_exception(RuntimeError("batching queue closed"))
+                raise
+            except Exception as e:  # resolve all waiters with the failure
+                log.exception("batch of %d failed", len(prompts))
+                for _, fut in group:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for (_, fut), answer in zip(group, answers):
+                if not fut.done():
+                    fut.set_result(answer)

@@ -1,0 +1,191 @@
+"""TutoringEngine: the TPU inference runtime behind `Tutoring.GetLLMAnswer`.
+
+Replaces the reference's module-global HF pipeline (reference:
+GUI_RAFT_LLM_SourceCode/tutoring_server.py:10-31) with a mesh-sharded JAX
+engine:
+
+- weights live once, sharded over the device mesh per `parallel.partition`
+  rules (tp for weight shards, dp for the request batch);
+- prompts are tokenized, **left-padded into static buckets** (length and
+  batch both bucketed to powers of two) so XLA compiles a small, finite set
+  of programs that are reused forever;
+- generation runs as one jitted prefill + while_loop decode program
+  (`engine.generate`), sampling included — a single device program per
+  request batch, no per-token host round-trip.
+
+The engine is synchronous and stateless per call; request coalescing lives
+in `engine.batcher` and the gRPC front-end in `serving.tutoring_server`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from functools import partial
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import convert, gpt2
+from ..parallel import mesh as mesh_lib
+from ..parallel import partition
+from ..utils import tokenizer as tok_lib
+from .generate import GenerateResult, generate, pick_bucket
+from .sampling import SamplingParams
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "gpt2"  # gpt2 | gpt2-medium | gpt2-large | gpt2-xl | tiny
+    checkpoint: Optional[str] = None  # .safetensors path (HF layout)
+    vocab_path: Optional[str] = None
+    merges_path: Optional[str] = None
+    sampling: SamplingParams = dataclasses.field(
+        default_factory=SamplingParams.reference_defaults
+    )
+    length_buckets: Tuple[int, ...] = (32, 64, 128, 256)
+    batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
+    tp: int = 1  # tensor-parallel ways; dp absorbs remaining devices
+    dtype: Any = jnp.bfloat16
+    seed: int = 0
+
+    @staticmethod
+    def model_config(name: str, dtype) -> gpt2.GPT2Config:
+        presets = {
+            "gpt2": gpt2.GPT2Config.small,
+            "gpt2-medium": gpt2.GPT2Config.medium,
+            "gpt2-large": gpt2.GPT2Config.large,
+            "gpt2-xl": gpt2.GPT2Config.xl,
+            "tiny": gpt2.GPT2Config.tiny,
+        }
+        if name not in presets:
+            raise ValueError(f"unknown model preset {name!r}")
+        return presets[name](dtype=dtype)
+
+
+class TutoringEngine:
+    def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None):
+        self.config = config
+        self.cfg = EngineConfig.model_config(config.model, config.dtype)
+        self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1}, devices=devices)
+        self.tokenizer = tok_lib.load_gpt2_tokenizer(
+            config.vocab_path, config.merges_path
+        )
+        if self.tokenizer.vocab_size > self.cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {self.tokenizer.vocab_size} exceeds model "
+                f"vocab {self.cfg.vocab_size}"
+            )
+        # Generation must leave room for at least one prompt token in the
+        # position table (see gpt2.forward precondition on silent clamping).
+        if config.sampling.max_new_tokens >= self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_new_tokens {config.sampling.max_new_tokens} must be < "
+                f"max_position_embeddings {self.cfg.max_position_embeddings} "
+                f"for model {config.model!r}"
+            )
+        self._rng = jax.random.key(config.seed)
+
+        t0 = time.monotonic()
+        if config.checkpoint:
+            sd = convert.load_safetensors(config.checkpoint)
+            params = convert.gpt2_params_from_hf(sd, self.cfg)
+        else:
+            log.warning("no checkpoint configured — randomly initialized %s",
+                        config.model)
+            params = gpt2.init_params(jax.random.key(config.seed), self.cfg)
+        self.params = partition.shard_tree(params, self.mesh, partition.GPT2_RULES)
+        log.info("params ready in %.1fs (mesh %s)", time.monotonic() - t0,
+                 dict(zip(self.mesh.axis_names, self.mesh.devices.shape)))
+
+        # One jitted wrapper; jit itself specializes/caches per input shape
+        # (one compiled program per (batch bucket, length bucket)).
+        self._generate = jax.jit(
+            partial(
+                generate,
+                cfg=self.cfg,
+                sampling=self.config.sampling,
+                eos_id=self.tokenizer.eos_id,
+                pad_id=self.tokenizer.pad_id,
+            )
+        )
+
+    def _max_prompt_len(self) -> int:
+        return min(
+            max(self.config.length_buckets),
+            self.cfg.max_position_embeddings - self.config.sampling.max_new_tokens,
+        )
+
+    def encode_prompts(self, prompts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Tokenize + left-pad into (ids, mask, bucket).
+
+        len(prompts) must not exceed the largest batch bucket (answer_batch
+        chunks larger groups).
+        """
+        if len(prompts) > max(self.config.batch_buckets):
+            raise ValueError(
+                f"{len(prompts)} prompts exceed the largest batch bucket "
+                f"{max(self.config.batch_buckets)}"
+            )
+        limit = self._max_prompt_len()
+        token_lists = []
+        for p in prompts:
+            toks = self.tokenizer.encode(p)[-limit:]  # keep the prompt tail
+            token_lists.append(toks if toks else [self.tokenizer.pad_id])
+        longest = max(len(t) for t in token_lists)
+        bucket = pick_bucket(longest, self.config.length_buckets)
+        bucket = min(bucket, limit)
+        nbatch = pick_bucket(len(prompts), self.config.batch_buckets)
+        ids = np.full((nbatch, bucket), self.tokenizer.pad_id, np.int32)
+        mask = np.zeros((nbatch, bucket), bool)
+        for i, toks in enumerate(token_lists):
+            ids[i, bucket - len(toks):] = toks
+            mask[i, bucket - len(toks):] = True
+        # Filler rows (batch bucketing) keep one valid token to stay well-formed.
+        for i in range(len(prompts), nbatch):
+            mask[i, -1] = True
+        return ids, mask, bucket
+
+    # ----------------------------------------------------------------- API
+
+    def warmup(self, batch: int = 8, bucket: Optional[int] = None) -> float:
+        """Pre-compile the hot program; returns compile seconds."""
+        bucket = bucket or self.config.length_buckets[0]
+        t0 = time.monotonic()
+        ids = np.zeros((batch, bucket), np.int32)
+        mask = np.ones((batch, bucket), bool)
+        self.generate_ids(ids, mask)
+        return time.monotonic() - t0
+
+    def generate_ids(self, ids: np.ndarray, mask: np.ndarray) -> GenerateResult:
+        self._rng, rng = jax.random.split(self._rng)
+        with self.mesh:
+            result = self._generate(self.params, input_ids=jnp.asarray(ids),
+                                    prompt_mask=jnp.asarray(mask), rng=rng)
+        return jax.device_get(result)
+
+    def answer_batch(self, prompts: Sequence[str]) -> List[str]:
+        """The serving entry: prompts in, decoded answers out.
+
+        Groups larger than the biggest batch bucket run as several device
+        batches (the batcher normally caps groups, but callers may not).
+        """
+        if not prompts:
+            return []
+        cap = max(self.config.batch_buckets)
+        answers: List[str] = []
+        for start in range(0, len(prompts), cap):
+            chunk = prompts[start : start + cap]
+            ids, mask, _ = self.encode_prompts(chunk)
+            result = self.generate_ids(ids, mask)
+            for i in range(len(chunk)):
+                n = int(result.lengths[i])
+                toks = [t for t in result.tokens[i, :n].tolist()
+                        if t != self.tokenizer.eos_id]
+                answers.append(self.tokenizer.decode(toks))
+        return answers

@@ -1,0 +1,143 @@
+"""Jitted autoregressive generation: bucketed prefill + while_loop decode.
+
+TPU-first replacement for the reference's `model.generate(...)` call
+(reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29): the whole
+prompt batch prefills in one static-shape pass, then a `lax.while_loop`
+decodes with a KV cache, sampling fused into the step — no host round-trips
+per token. Early exit when every row has emitted EOS.
+
+Shapes are static: prompts are left-padded to a bucket length; the cache is
+sized exactly `bucket + max_new_tokens` so the precondition documented in
+models/gpt2.py (no silent cache overflow) holds by construction.
+
+The reference caps *total* length at 150 (`max_length`), which silently
+leaves no room to answer long prompts (SURVEY.md §5 latent defect); here the
+budget is `max_new_tokens` — always that much room to answer.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import gpt2
+from .sampling import SamplingParams, sample_step, seen_mask_from_ids, update_seen
+
+
+class GenerateResult(NamedTuple):
+    tokens: jax.Array   # [B, max_new] int32; rows padded with pad_id after EOS
+    lengths: jax.Array  # [B] int32 — emitted tokens per row (including EOS)
+
+
+def make_positions(prompt_mask: jax.Array) -> jax.Array:
+    """Per-row position ids for a left-padded prompt ([B, T] bool -> int32)."""
+    return jnp.maximum(jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0)
+
+
+def generate(
+    params,
+    cfg: gpt2.GPT2Config,
+    input_ids: jax.Array,
+    prompt_mask: jax.Array,
+    rng: jax.Array,
+    sampling: SamplingParams,
+    eos_id: int,
+    pad_id: int,
+) -> GenerateResult:
+    """Sample continuations for a left-padded prompt batch.
+
+    Pure and jittable: `cfg`, `sampling`, `eos_id`, `pad_id` are static.
+    input_ids [B, T] int32, prompt_mask [B, T] bool (False = left padding).
+    """
+    b, t = input_ids.shape
+    max_new = sampling.max_new_tokens
+    if t + max_new > cfg.max_position_embeddings:
+        raise ValueError(
+            f"bucket {t} + max_new {max_new} exceeds position table "
+            f"{cfg.max_position_embeddings}"
+        )
+    cache_len = t + max_new
+    vocab = cfg.vocab_size
+
+    positions = make_positions(prompt_mask)
+    real_lens = jnp.sum(prompt_mask.astype(jnp.int32), axis=1)  # [B]
+
+    cache = gpt2.init_cache(cfg, b, cache_len, dtype=cfg.dtype)
+    # Slots 0..t-1 hold the (partly padded) prompt; decode slots are real.
+    kv_mask = jnp.concatenate(
+        [prompt_mask.astype(jnp.bool_), jnp.ones((b, max_new), jnp.bool_)], axis=1
+    )
+
+    logits, cache = gpt2.forward(
+        params, cfg, input_ids, cache=cache, positions=positions, kv_mask=kv_mask
+    )
+    last_logits = logits[:, -1]  # left-padding ⇒ every row's last slot is real
+
+    seen = seen_mask_from_ids(input_ids, prompt_mask, vocab)
+
+    rng, step_rng = jax.random.split(rng)
+    first_tok = sample_step(step_rng, last_logits, seen, sampling)
+
+    class State(NamedTuple):
+        cache: gpt2.KVCache
+        tok: jax.Array        # [B] last sampled token
+        rng: jax.Array
+        out: jax.Array        # [B, max_new]
+        seen: jax.Array       # [B, V]
+        done: jax.Array       # [B]
+        lengths: jax.Array    # [B]
+        step: jax.Array       # []
+
+    out0 = jnp.full((b, max_new), pad_id, jnp.int32)
+    out0 = out0.at[:, 0].set(first_tok)
+    done0 = first_tok == eos_id
+    state = State(
+        cache=cache,
+        tok=first_tok,
+        rng=rng,
+        out=out0,
+        seen=update_seen(seen, first_tok),
+        done=done0,
+        lengths=jnp.ones((b,), jnp.int32),
+        step=jnp.ones((), jnp.int32),
+    )
+
+    def cond(s: State):
+        return (s.step < max_new) & ~jnp.all(s.done)
+
+    def body(s: State) -> State:
+        # Feed last token; its slot is t + step - 1, its position is
+        # real_lens + step - 1 (both per the left-padded layout).
+        pos = (real_lens + s.step - 1)[:, None]
+        logits, cache = gpt2.forward(
+            params, cfg, s.tok[:, None], cache=s.cache, positions=pos, kv_mask=kv_mask
+        )
+        rng, step_rng = jax.random.split(s.rng)
+        nxt = sample_step(step_rng, logits[:, 0], s.seen, sampling)
+        nxt = jnp.where(s.done, jnp.asarray(pad_id, jnp.int32), nxt)
+        out = jax.lax.dynamic_update_slice(s.out, nxt[:, None], (0, s.step))
+        lengths = s.lengths + (~s.done).astype(jnp.int32)
+        done = s.done | (nxt == eos_id)
+        return State(
+            cache=cache,
+            tok=nxt,
+            rng=rng,
+            out=out,
+            seen=update_seen(s.seen, nxt),
+            done=done,
+            lengths=lengths,
+            step=s.step + 1,
+        )
+
+    final = jax.lax.while_loop(cond, body, state)
+    return GenerateResult(tokens=final.out, lengths=final.lengths)
+
+
+def pick_bucket(length: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket >= length (last bucket if none fit — caller truncates)."""
+    for bkt in buckets:
+        if length <= bkt:
+            return bkt
+    return buckets[-1]

@@ -51,7 +51,7 @@ class BertConfig:
 
     @classmethod
     def tiny(cls, **kw) -> "BertConfig":
-        kw.setdefault("vocab_size", 128)
+        kw.setdefault("vocab_size", 384)
         kw.setdefault("max_position_embeddings", 64)
         return cls(hidden_size=32, num_layers=2, num_heads=4, **kw)
 

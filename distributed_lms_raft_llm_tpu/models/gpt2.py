@@ -77,7 +77,7 @@ class GPT2Config:
     @classmethod
     def tiny(cls, **kw) -> "GPT2Config":
         """Test-size config (fast CPU golden tests vs HF)."""
-        kw.setdefault("vocab_size", 128)
+        kw.setdefault("vocab_size", 384)
         kw.setdefault("max_position_embeddings", 64)
         return cls(hidden_size=32, num_layers=2, num_heads=4, **kw)
 

@@ -1,0 +1,11 @@
+"""SPMD parallelism: mesh construction, partition rules, ring attention."""
+
+from .mesh import make_mesh, named_sharding, single_device_mesh  # noqa: F401
+from .partition import (  # noqa: F401
+    BERT_RULES,
+    CACHE_SPEC,
+    GPT2_RULES,
+    match_partition_rules,
+    shard_tree,
+    shardings_for,
+)

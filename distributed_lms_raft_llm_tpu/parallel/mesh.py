@@ -1,0 +1,71 @@
+"""Device-mesh construction for SPMD sharding.
+
+The reference has no compute parallelism (SURVEY.md §2.2) — its distribution
+is Raft replication over gRPC. Here the TPU compute plane scales the JAX way:
+a `jax.sharding.Mesh` over the local chips with named axes, `NamedSharding`
+partition specs on parameter/cache pytrees, and XLA-inserted collectives over
+ICI. Axes used across the framework:
+
+- ``dp`` — data parallel (batch of concurrent student queries)
+- ``tp`` — tensor parallel (weight shards; the BASELINE GPT-2-large/8-chip
+  and Llama-3-8B/16-chip configs)
+- ``sp`` — sequence/context parallel (ring attention for long context)
+- ``pp`` — pipeline stages (train-time; optional)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    axis_sizes: Optional[dict] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_order: Tuple[str, ...] = ("dp", "pp", "sp", "tp"),
+) -> Mesh:
+    """Build a mesh over the given (default: all local) devices.
+
+    axis_sizes maps axis name -> size; at most one axis may be -1 (inferred).
+    Axes not mentioned get size 1. `tp` is placed innermost (fastest-varying)
+    so tensor-parallel collectives ride the shortest ICI hops.
+
+    >>> make_mesh({"dp": 2, "tp": 4})  # 8 devices: 2-way data, 4-way tensor
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axis_sizes or {})
+    unknown = [a for a in sizes if a not in axis_order]
+    if unknown:
+        raise ValueError(f"unknown mesh axes {unknown}; expected {axis_order}")
+    infer = [a for a, s in sizes.items() if s == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = math.prod(s for s in sizes.values() if s != -1)
+    if infer:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[infer[0]] = n // known
+    elif known != n:
+        # Default: put the remainder on dp if unset, else require exact fit.
+        if "dp" not in sizes and n % known == 0:
+            sizes["dp"] = n // known
+        else:
+            raise ValueError(f"axis sizes {sizes} do not multiply to {n} devices")
+    shape = [sizes.get(a, 1) for a in axis_order]
+    mesh_devices = np.asarray(devices).reshape(shape)
+    return Mesh(mesh_devices, axis_order)
+
+
+def single_device_mesh() -> Mesh:
+    """Trivial mesh (1 chip) — lets the same pjit code path serve everywhere."""
+    return make_mesh({})
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,99 @@
+"""Lightweight serving metrics: counters + latency histograms.
+
+The reference has no observability beyond ~80 print() call sites
+(SURVEY.md §5). The BASELINE north-star metric is p50 TTFT per student
+query, so latency percentiles are first-class here: every RPC entry point
+records into a histogram, and servers log/export snapshots.
+
+Thread-safe, dependency-free; values are plain floats so snapshots can be
+JSON-serialized straight into logs or the bench harness.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class LatencyHistogram:
+    """Reservoir of recent latencies with percentile queries."""
+
+    def __init__(self, max_samples: int = 4096):
+        self._samples: List[float] = []
+        self._max = max_samples
+        self._count = 0
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += seconds
+            bisect.insort(self._samples, seconds)
+            if len(self._samples) > self._max:
+                # Drop alternating extremes to keep the reservoir centered.
+                self._samples.pop(0 if self._count % 2 else -1)
+
+    def percentile(self, p: float) -> Optional[float]:
+        with self._lock:
+            if not self._samples:
+                return None
+            idx = min(int(len(self._samples) * p / 100.0), len(self._samples) - 1)
+            return self._samples[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            n = len(self._samples)
+            if n == 0:
+                return {"count": 0}
+            return {
+                "count": self._count,
+                "mean_s": self._total / self._count,
+                "p50_s": self._samples[n // 2],
+                "p90_s": self._samples[min(int(n * 0.9), n - 1)],
+                "p99_s": self._samples[min(int(n * 0.99), n - 1)],
+                "max_s": self._samples[-1],
+            }
+
+
+class Metrics:
+    """Named counters + histograms; one instance per server process."""
+
+    def __init__(self):
+        self._counters: Dict[str, int] = {}
+        self._hists: Dict[str, LatencyHistogram] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, amount: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def hist(self, name: str) -> LatencyHistogram:
+        with self._lock:
+            if name not in self._hists:
+                self._hists[name] = LatencyHistogram()
+            return self._hists[name]
+
+    def time(self, name: str) -> "_Timer":
+        return _Timer(self.hist(name))
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counters = dict(self._counters)
+            hists = {k: h.snapshot() for k, h in self._hists.items()}
+        return {"counters": counters, "latency": hists}
+
+
+class _Timer:
+    def __init__(self, hist: LatencyHistogram):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.monotonic() - self._t0)
+        return False

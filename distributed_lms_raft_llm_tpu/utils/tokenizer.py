@@ -1,0 +1,249 @@
+"""Tokenizers for the serving path — pure Python, zero network, no torch.
+
+The reference delegates tokenization to HF `GPT2Tokenizer` /
+`BertTokenizer` pulled from the hub (reference:
+GUI_RAFT_LLM_SourceCode/tutoring_server.py:10, lms_server.py:11). This image
+has no network egress, so we implement the two algorithms directly and load
+their vocab files from disk when available:
+
+- `BPETokenizer`   — GPT-2's byte-level BPE, from `vocab.json` + `merges.txt`.
+- `WordPieceTokenizer` — BERT's WordPiece, from `vocab.txt`.
+- `ByteTokenizer`  — a self-contained byte-level fallback (ids 0..255 plus
+  specials) used when no vocab files are configured; keeps the whole serving
+  stack runnable end-to-end with randomly initialized models.
+
+All expose: `encode(text) -> List[int]`, `decode(ids) -> str`,
+`vocab_size`, `eos_id`, `pad_id`.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@lru_cache()
+def _bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte <-> printable-unicode mapping."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+# GPT-2's pre-tokenization pattern (contractions, words, numbers, punct, ws).
+_GPT2_PAT = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?[0-9]+| ?[^\sA-Za-z0-9]+|\s+(?!\S)|\s+"
+)
+
+
+class BPETokenizer:
+    """GPT-2 byte-level BPE from vocab.json + merges.txt."""
+
+    def __init__(self, vocab: Dict[str, int], merges: Sequence[Tuple[str, str]]):
+        self.encoder = dict(vocab)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        self.bpe_ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.byte_encoder = _bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self._cache: Dict[str, List[str]] = {}
+        self.eos_id = self.encoder.get("<|endoftext|>", len(self.encoder) - 1)
+        self.pad_id = self.eos_id
+
+    @classmethod
+    def from_files(cls, vocab_path: str, merges_path: str) -> "BPETokenizer":
+        with open(vocab_path, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges = []
+        with open(merges_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split()
+                if len(parts) == 2:
+                    merges.append((parts[0], parts[1]))
+        return cls(vocab, merges)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    def _bpe(self, token: str) -> List[str]:
+        if token in self._cache:
+            return self._cache[token]
+        word: List[str] = list(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if best not in self.bpe_ranks:
+                break
+            first, second = best
+            merged: List[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == first and word[i + 1] == second:
+                    merged.append(first + second)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = merged
+        self._cache[token] = word
+        return word
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for tok in _GPT2_PAT.findall(text):
+            tok_bytes = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
+            for piece in self._bpe(tok_bytes):
+                ids.append(self.encoder[piece])
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        text = "".join(self.decoder.get(int(i), "") for i in ids)
+        data = bytearray(self.byte_decoder.get(ch, ord("?")) for ch in text)
+        return data.decode("utf-8", errors="replace")
+
+
+class WordPieceTokenizer:
+    """BERT WordPiece from vocab.txt, with BERT basic (lowercase) pre-split."""
+
+    def __init__(self, vocab: Dict[str, int], lowercase: bool = True):
+        self.vocab = dict(vocab)
+        self.ids_to_tokens = {v: k for k, v in self.vocab.items()}
+        self.lowercase = lowercase
+        self.unk_id = self.vocab.get("[UNK]", 0)
+        self.cls_id = self.vocab.get("[CLS]", 0)
+        self.sep_id = self.vocab.get("[SEP]", 0)
+        self.pad_id = self.vocab.get("[PAD]", 0)
+        self.eos_id = self.sep_id
+
+    @classmethod
+    def from_file(cls, vocab_path: str, lowercase: bool = True) -> "WordPieceTokenizer":
+        vocab = {}
+        with open(vocab_path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                vocab[line.rstrip("\n")] = i
+        return cls(vocab, lowercase)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def _split(self, text: str) -> List[str]:
+        if self.lowercase:
+            text = text.lower()
+        # Split on whitespace, then isolate punctuation characters.
+        out: List[str] = []
+        for chunk in text.split():
+            cur = ""
+            for ch in chunk:
+                if not ch.isalnum():
+                    if cur:
+                        out.append(cur)
+                        cur = ""
+                    out.append(ch)
+                else:
+                    cur += ch
+            if cur:
+                out.append(cur)
+        return out
+
+    def _wordpiece(self, word: str) -> List[int]:
+        if len(word) > 100:
+            return [self.unk_id]
+        ids: List[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece_id = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    piece_id = self.vocab[piece]
+                    break
+                end -= 1
+            if piece_id is None:
+                return [self.unk_id]
+            ids.append(piece_id)
+            start = end
+        return ids
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> List[int]:
+        ids: List[int] = []
+        for word in self._split(text):
+            ids.extend(self._wordpiece(word))
+        if add_special_tokens:
+            ids = [self.cls_id] + ids + [self.sep_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        toks = [self.ids_to_tokens.get(int(i), "[UNK]") for i in ids]
+        out = []
+        for t in toks:
+            if t in ("[CLS]", "[SEP]", "[PAD]"):
+                continue
+            if t.startswith("##") and out:
+                out[-1] += t[2:]
+            else:
+                out.append(t)
+        return " ".join(out)
+
+
+class ByteTokenizer:
+    """Fallback: UTF-8 bytes as ids 0..255; specials above.
+
+    Keeps every text path (serving, gate, tests, demos) runnable without any
+    vocab files. id 256 = BOS/EOS/pad.
+    """
+
+    def __init__(self, vocab_size: int = 257):
+        assert vocab_size >= 257
+        self._vocab_size = vocab_size
+        self.eos_id = 256
+        self.pad_id = 256
+        self.cls_id = 256
+        self.sep_id = 256
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab_size
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_special_tokens:
+            ids = [self.cls_id] + ids + [self.sep_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in (int(x) for x in ids) if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def load_gpt2_tokenizer(
+    vocab_path: Optional[str] = None, merges_path: Optional[str] = None
+):
+    """BPE if vocab files are configured/present, else byte fallback."""
+    if vocab_path and merges_path:
+        return BPETokenizer.from_files(vocab_path, merges_path)
+    return ByteTokenizer()
+
+
+def load_bert_tokenizer(vocab_path: Optional[str] = None):
+    if vocab_path:
+        return WordPieceTokenizer.from_file(vocab_path)
+    return ByteTokenizer()

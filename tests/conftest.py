@@ -2,30 +2,30 @@
 
 Tests run on a virtual 8-device CPU mesh so that every sharding / collective
 path (tp/dp/sp ring attention, pjit train step) is exercised without TPU
-hardware. These env vars must be set before JAX initializes its backends,
-hence at conftest import time.
+hardware.
+
+The image's sitecustomize registers the experimental 'axon' TPU backend and
+*overwrites* `jax_platforms` at interpreter start, so env vars alone
+(JAX_PLATFORMS / XLA_FLAGS) are not enough — we must override the config
+after importing jax, before any backend is touched.
 """
 
 import os
 
-# Hard override: the ambient environment pins JAX_PLATFORMS to the real TPU
-# ('axon'); tests must run on the virtual CPU mesh.
+# Harmless extra belt-and-braces for subprocesses spawned by tests.
 os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-# Keep test-time compiles cheap and deterministic.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
     return devices[:8]

@@ -1,0 +1,102 @@
+"""End-to-end tutoring server test: tiny engine behind real gRPC."""
+
+import asyncio
+import threading
+
+import grpc
+import pytest
+
+import jax
+
+from distributed_lms_raft_llm_tpu.engine import (
+    EngineConfig,
+    SamplingParams,
+    TutoringEngine,
+)
+from distributed_lms_raft_llm_tpu.proto import lms_pb2, rpc
+from distributed_lms_raft_llm_tpu.serving import tutoring_server
+
+
+@pytest.fixture(scope="module")
+def server_addr():
+    """Run the aio server on a private event loop thread."""
+    engine = TutoringEngine(
+        EngineConfig(
+            model="tiny",
+            sampling=SamplingParams(max_new_tokens=6),
+            length_buckets=(32,),
+            batch_buckets=(1, 2, 4),
+            dtype=jax.numpy.float32,
+        )
+    )
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            server = grpc.aio.server()
+            from distributed_lms_raft_llm_tpu.engine import BatchingQueue
+            from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+            queue = BatchingQueue(engine, max_batch=4, max_wait_ms=20)
+            await queue.start()
+            metrics = Metrics()
+            rpc.add_TutoringServicer_to_server(
+                tutoring_server.TutoringService(queue, metrics), server
+            )
+            port = server.add_insecure_port("127.0.0.1:0")
+            await server.start()
+            state["port"] = port
+            state["server"] = server
+            state["metrics"] = metrics
+            started.set()
+            await server.wait_for_termination()
+
+        loop.run_until_complete(boot())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=60)
+    yield f"127.0.0.1:{state['port']}", state
+    asyncio.run_coroutine_threadsafe(state["server"].stop(None), loop)
+
+
+def test_get_llm_answer_over_wire(server_addr):
+    addr, state = server_addr
+    with grpc.insecure_channel(addr) as channel:
+        stub = rpc.TutoringStub(channel)
+        resp = stub.GetLLMAnswer(
+            lms_pb2.QueryRequest(token="t", query="What is a mutex?"), timeout=120
+        )
+    assert resp.success
+    assert isinstance(resp.response, str)
+    snap = state["metrics"].snapshot()
+    assert snap["counters"]["llm_requests"] == 1
+    assert snap["latency"]["ttft"]["count"] == 1
+
+
+def test_concurrent_queries_batched(server_addr):
+    addr, state = server_addr
+    with grpc.insecure_channel(addr) as channel:
+        stub = rpc.TutoringStub(channel)
+        futures = [
+            stub.GetLLMAnswer.future(
+                lms_pb2.QueryRequest(token="t", query=f"question {i}"), timeout=120
+            )
+            for i in range(4)
+        ]
+        responses = [f.result() for f in futures]
+    assert all(r.success for r in responses)
+
+
+def test_empty_query_rejected(server_addr):
+    addr, _ = server_addr
+    with grpc.insecure_channel(addr) as channel:
+        stub = rpc.TutoringStub(channel)
+        resp = stub.GetLLMAnswer(
+            lms_pb2.QueryRequest(token="t", query="   "), timeout=30
+        )
+    assert not resp.success
