@@ -1,0 +1,14 @@
+"""Raft consensus: sans-IO core, durable storage, asyncio node, transports."""
+
+from .core import NotLeader, RaftConfig, RaftCore, Role  # noqa: F401
+from .messages import (  # noqa: F401
+    AppendRequest,
+    AppendResponse,
+    Entry,
+    VoteRequest,
+    VoteResponse,
+    decode_command,
+    encode_command,
+)
+from .node import MemNetwork, MemTransport, RaftNode, Transport  # noqa: F401
+from .storage import FileStorage, MemoryStorage  # noqa: F401

@@ -1,0 +1,346 @@
+"""Sans-IO Raft core: the consensus state machine, no clocks, no sockets.
+
+A clean-room implementation of leader election + log replication (Raft §5)
+replacing the reference's thread-racy, lockless version (reference:
+GUI_RAFT_LLM_SourceCode/lms_server.py:107-697; defects D2 nextIndex
+off-by-one, D3 missing Candidate state, D10 unsynchronized shared state,
+D11 heartbeat-every-tick). Design:
+
+- **Sans-IO**: every method is a synchronous transition taking explicit
+  `now` timestamps; outbound messages accumulate in `outbox` for a runner
+  (`raft.node`) to deliver. Single-threaded by construction — the runner is
+  one asyncio task, so there is nothing to lock (SURVEY.md §5 race-detection
+  strategy: safety by construction + deterministic simulation tests).
+- **Durability**: current_term / voted_for / log changes go through the
+  injected storage *before* any message referencing them leaves the node
+  (the reference persisted none of these).
+- **1-based log indexing**; index 0 is the empty sentinel.
+- On winning an election the leader appends a no-op barrier entry so the
+  new term can commit immediately (Raft §5.4.2 commit rule).
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .messages import (
+    NOOP,
+    AppendRequest,
+    AppendResponse,
+    Entry,
+    VoteRequest,
+    VoteResponse,
+)
+
+
+class Role(enum.Enum):
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+class RaftConfig:
+    """Timing knobs (seconds). Defaults match textbook Raft; the reference's
+    10-30s election timeouts (lms_server.py:672) are reproducible by
+    construction-time override for wire-compat demos."""
+
+    def __init__(
+        self,
+        election_timeout_min: float = 0.15,
+        election_timeout_max: float = 0.30,
+        heartbeat_interval: float = 0.05,
+        max_entries_per_append: int = 64,
+    ):
+        assert election_timeout_min > 2 * heartbeat_interval
+        self.election_timeout_min = election_timeout_min
+        self.election_timeout_max = election_timeout_max
+        self.heartbeat_interval = heartbeat_interval
+        self.max_entries_per_append = max_entries_per_append
+
+
+class RaftCore:
+    def __init__(
+        self,
+        node_id: int,
+        peer_ids: Sequence[int],
+        storage,
+        config: Optional[RaftConfig] = None,
+        *,
+        now: float = 0.0,
+        seed: Optional[int] = None,
+    ):
+        self.node_id = node_id
+        self.peer_ids = [p for p in peer_ids if p != node_id]
+        self.storage = storage
+        self.config = config or RaftConfig()
+        self._rng = random.Random(node_id if seed is None else seed)
+
+        # Persistent state (restored from storage).
+        self.current_term, self.voted_for, self.log = storage.load()
+
+        # Volatile state.
+        self.role = Role.FOLLOWER
+        self.leader_id: Optional[int] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.votes: Set[int] = set()
+        self.next_index: Dict[int, int] = {}
+        self.match_index: Dict[int, int] = {}
+        self._last_heartbeat_sent = 0.0
+
+        # (peer_id, message) pairs for the runner to deliver.
+        self.outbox: List[Tuple[int, object]] = []
+        self.election_deadline = now + self._election_timeout()
+
+    # ------------------------------------------------------------- helpers
+
+    def _election_timeout(self) -> float:
+        return self._rng.uniform(
+            self.config.election_timeout_min, self.config.election_timeout_max
+        )
+
+    def _reset_election_timer(self, now: float) -> None:
+        self.election_deadline = now + self._election_timeout()
+
+    @property
+    def last_log_index(self) -> int:
+        return len(self.log)
+
+    @property
+    def last_log_term(self) -> int:
+        return self.log[-1].term if self.log else 0
+
+    def entry_term(self, index: int) -> int:
+        if index == 0:
+            return 0
+        return self.log[index - 1].term
+
+    def quorum(self) -> int:
+        return (len(self.peer_ids) + 1) // 2 + 1
+
+    def _persist_meta(self) -> None:
+        self.storage.save_meta(self.current_term, self.voted_for)
+
+    # ---------------------------------------------------------- transitions
+
+    def tick(self, now: float) -> None:
+        """Advance timers: elections for followers/candidates, heartbeats
+        for leaders."""
+        if self.role is Role.LEADER:
+            if now - self._last_heartbeat_sent >= self.config.heartbeat_interval:
+                self.broadcast_append(now)
+        elif now >= self.election_deadline:
+            self.start_election(now)
+
+    def start_election(self, now: float) -> None:
+        self.role = Role.CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.node_id
+        self._persist_meta()
+        self.leader_id = None
+        self.votes = {self.node_id}
+        self._reset_election_timer(now)
+        req = VoteRequest(
+            term=self.current_term,
+            candidate_id=self.node_id,
+            last_log_index=self.last_log_index,
+            last_log_term=self.last_log_term,
+        )
+        for peer in self.peer_ids:
+            self.outbox.append((peer, req))
+        self._maybe_win(now)  # single-node cluster wins immediately
+
+    def _step_down(self, term: int, now: float) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist_meta()
+        self.role = Role.FOLLOWER
+        self.votes = set()
+        self._reset_election_timer(now)
+
+    # Vote handling -------------------------------------------------------
+
+    def on_vote_request(self, req: VoteRequest, now: float) -> VoteResponse:
+        if req.term > self.current_term:
+            self._step_down(req.term, now)
+        granted = False
+        if req.term == self.current_term:
+            up_to_date = (req.last_log_term, req.last_log_index) >= (
+                self.last_log_term,
+                self.last_log_index,
+            )
+            if self.voted_for in (None, req.candidate_id) and up_to_date:
+                granted = True
+                if self.voted_for is None:
+                    self.voted_for = req.candidate_id
+                    self._persist_meta()
+                self._reset_election_timer(now)
+        return VoteResponse(term=self.current_term, granted=granted)
+
+    def on_vote_response(self, peer: int, resp: VoteResponse, now: float) -> None:
+        if resp.term > self.current_term:
+            self._step_down(resp.term, now)
+            return
+        if self.role is not Role.CANDIDATE or resp.term != self.current_term:
+            return
+        if resp.granted:
+            self.votes.add(peer)
+            self._maybe_win(now)
+
+    def _maybe_win(self, now: float) -> None:
+        if self.role is Role.CANDIDATE and len(self.votes) >= self.quorum():
+            self.role = Role.LEADER
+            self.leader_id = self.node_id
+            self.next_index = {p: self.last_log_index + 1 for p in self.peer_ids}
+            self.match_index = {p: 0 for p in self.peer_ids}
+            # No-op barrier: lets this term commit without waiting for client
+            # traffic (and thereby commits all prior-term entries).
+            self.log.append(Entry(term=self.current_term, command=NOOP))
+            self.storage.append_entries(self.last_log_index, self.log[-1:])
+            self._advance_commit()
+            self.broadcast_append(now)
+
+    # Append handling -----------------------------------------------------
+
+    def append_request_for(self, peer: int) -> AppendRequest:
+        """Build the next AppendEntries for `peer` from its next_index."""
+        nxt = self.next_index.get(peer, self.last_log_index + 1)
+        prev = nxt - 1
+        entries = tuple(
+            self.log[prev : prev + self.config.max_entries_per_append]
+        )
+        return AppendRequest(
+            term=self.current_term,
+            leader_id=self.node_id,
+            prev_log_index=prev,
+            prev_log_term=self.entry_term(prev),
+            entries=entries,
+            leader_commit=self.commit_index,
+        )
+
+    def broadcast_append(self, now: float) -> None:
+        self._last_heartbeat_sent = now
+        for peer in self.peer_ids:
+            self.outbox.append((peer, self.append_request_for(peer)))
+
+    def on_append_request(self, req: AppendRequest, now: float) -> AppendResponse:
+        if req.term > self.current_term:
+            self._step_down(req.term, now)
+        if req.term < self.current_term:
+            return AppendResponse(term=self.current_term, success=False)
+        # Valid leader for this term.
+        if self.role is not Role.FOLLOWER:
+            self._step_down(req.term, now)
+        self.leader_id = req.leader_id
+        self._reset_election_timer(now)
+
+        if req.prev_log_index > self.last_log_index:
+            # Missing entries: tell the leader where our log ends.
+            return AppendResponse(
+                term=self.current_term,
+                success=False,
+                conflict_index=self.last_log_index + 1,
+            )
+        if (
+            req.prev_log_index > 0
+            and self.entry_term(req.prev_log_index) != req.prev_log_term
+        ):
+            # Term conflict: find the first index of the conflicting term so
+            # the leader can jump the whole term.
+            bad_term = self.entry_term(req.prev_log_index)
+            first = req.prev_log_index
+            while first > 1 and self.entry_term(first - 1) == bad_term:
+                first -= 1
+            return AppendResponse(
+                term=self.current_term, success=False, conflict_index=first
+            )
+
+        # Append / overwrite. Only truncate on a real mismatch (RPCs may be
+        # stale or duplicated).
+        index = req.prev_log_index
+        for i, entry in enumerate(req.entries):
+            index = req.prev_log_index + 1 + i
+            if index <= self.last_log_index:
+                if self.entry_term(index) != entry.term:
+                    del self.log[index - 1 :]
+                    self.storage.truncate_from(index)
+                else:
+                    continue
+            self.log.append(entry)
+            self.storage.append_entries(index, [entry])
+
+        if req.leader_commit > self.commit_index:
+            self.commit_index = min(req.leader_commit, self.last_log_index)
+        return AppendResponse(
+            term=self.current_term, success=True, match_index=index
+        )
+
+    def on_append_response(
+        self, peer: int, resp: AppendResponse, now: float
+    ) -> None:
+        if resp.term > self.current_term:
+            self._step_down(resp.term, now)
+            return
+        if self.role is not Role.LEADER or resp.term != self.current_term:
+            return
+        if resp.success:
+            if resp.match_index > self.match_index.get(peer, 0):
+                self.match_index[peer] = resp.match_index
+            self.next_index[peer] = self.match_index[peer] + 1
+            self._advance_commit()
+            # Keep streaming if the peer is still behind — otherwise catch-up
+            # would be paced at max_entries_per_append per heartbeat.
+            if self.next_index[peer] <= self.last_log_index:
+                self.outbox.append((peer, self.append_request_for(peer)))
+        else:
+            if resp.conflict_index > 0:
+                self.next_index[peer] = max(1, resp.conflict_index)
+            else:
+                self.next_index[peer] = max(1, self.next_index.get(peer, 1) - 1)
+            # Retry immediately with the corrected window.
+            self.outbox.append((peer, self.append_request_for(peer)))
+
+    def _advance_commit(self) -> None:
+        """Majority-match advance, current-term entries only (Raft §5.4.2)."""
+        for index in range(self.last_log_index, self.commit_index, -1):
+            if self.entry_term(index) != self.current_term:
+                break
+            count = 1 + sum(
+                1 for p in self.peer_ids if self.match_index.get(p, 0) >= index
+            )
+            if count >= self.quorum():
+                self.commit_index = index
+                break
+
+    # Client-facing -------------------------------------------------------
+
+    def propose(self, command: str, now: float) -> int:
+        """Leader-only: append a command; returns its log index."""
+        if self.role is not Role.LEADER:
+            raise NotLeader(self.leader_id)
+        self.log.append(Entry(term=self.current_term, command=command))
+        self.storage.append_entries(self.last_log_index, self.log[-1:])
+        self._advance_commit()  # single-node clusters commit instantly
+        self.broadcast_append(now)
+        return self.last_log_index
+
+    def take_applies(self) -> List[Tuple[int, Entry]]:
+        """Entries newly committed since the last call (for the app FSM)."""
+        out = []
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            out.append((self.last_applied, self.log[self.last_applied - 1]))
+        return out
+
+    def drain_outbox(self) -> List[Tuple[int, object]]:
+        out, self.outbox = self.outbox, []
+        return out
+
+
+class NotLeader(Exception):
+    def __init__(self, leader_id: Optional[int]):
+        super().__init__(f"not the leader (known leader: {leader_id})")
+        self.leader_id = leader_id

@@ -1,0 +1,74 @@
+"""Raft message dataclasses and the structured command codec.
+
+Transport-neutral: `raft.core` speaks only these types; the gRPC layer
+(`raft.service` / `raft.grpc_transport`) converts them to the frozen wire
+messages (lms.proto TermCandIDPair / TermResultPair / TermLeaderIDPair
+quirks included).
+
+Commands are JSON objects `{"op": ..., "args": {...}}` encoded/decoded by
+ONE codec used on both the propose and apply sides — the reference JSON-
+encodes on propose but string-splits on apply, so committed commands can
+never round-trip (reference: GUI_RAFT_LLM_SourceCode/lms_server.py:335-340
+vs :263-268, defect D1). Fixed by construction here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    term: int
+    command: str
+
+
+@dataclasses.dataclass(frozen=True)
+class VoteRequest:
+    term: int
+    candidate_id: int
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclasses.dataclass(frozen=True)
+class VoteResponse:
+    term: int
+    granted: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class AppendRequest:
+    term: int
+    leader_id: int
+    prev_log_index: int
+    prev_log_term: int
+    entries: Tuple[Entry, ...]
+    leader_commit: int
+
+
+@dataclasses.dataclass(frozen=True)
+class AppendResponse:
+    term: int
+    success: bool
+    # Fast conflict backtracking (§5.3 optimization): on mismatch the
+    # follower reports a hint so the leader can skip whole terms instead of
+    # decrementing next_index one step per round trip.
+    match_index: int = 0
+    conflict_index: int = 0
+
+
+def encode_command(op: str, args: Optional[Dict[str, Any]] = None) -> str:
+    return json.dumps({"op": op, "args": args or {}}, sort_keys=True)
+
+
+def decode_command(command: str) -> Tuple[str, Dict[str, Any]]:
+    obj = json.loads(command)
+    if not isinstance(obj, dict) or "op" not in obj:
+        raise ValueError(f"malformed raft command: {command!r}")
+    return obj["op"], obj.get("args", {})
+
+
+NOOP = encode_command("noop")

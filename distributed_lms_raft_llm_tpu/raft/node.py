@@ -1,0 +1,258 @@
+"""RaftNode: the asyncio runner around the sans-IO core.
+
+One task owns the core (single-threaded by construction — the race-free
+replacement for the reference's ticker-thread + gRPC-thread-pool mutation of
+shared state, defect D10). Responsibilities:
+
+- periodic `core.tick()` (elections, heartbeats at the configured interval —
+  not per-tick like the reference's D11);
+- draining the core's outbox through a `Transport` and feeding responses
+  back in;
+- resolving `propose()` futures when entries COMMIT (the reference ACKs
+  before replication, defect D9) — and failing them on leadership loss;
+- handing newly committed commands to the application's apply callback.
+
+Transports are pluggable: `MemTransport` (deterministic in-process cluster
+with drop/partition/delay injection) and `raft.grpc_transport.GrpcTransport`
+(the wire).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from .core import NotLeader, RaftConfig, RaftCore, Role
+from .messages import (
+    NOOP,
+    AppendRequest,
+    AppendResponse,
+    Entry,
+    VoteRequest,
+    VoteResponse,
+)
+
+log = logging.getLogger(__name__)
+
+ApplyCallback = Callable[[int, Entry], None]
+
+
+class Transport:
+    """Delivers a request to a peer and returns its response (or raises)."""
+
+    async def send(self, peer: int, message) -> object:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: int,
+        peer_ids,
+        storage,
+        transport: Transport,
+        apply_cb: Optional[ApplyCallback] = None,
+        config: Optional[RaftConfig] = None,
+        *,
+        tick_interval: float = 0.01,
+        seed: Optional[int] = None,
+    ):
+        self.core = RaftCore(
+            node_id, peer_ids, storage, config, now=time.monotonic(), seed=seed
+        )
+        self.transport = transport
+        self.apply_cb = apply_cb
+        self.tick_interval = tick_interval
+        # index -> [(expected_term, future)]: a waiter only resolves if the
+        # entry committed at its index carries the term it was proposed in —
+        # otherwise a new leader's different entry at the same index would be
+        # mistaken for our commit.
+        self._commit_waiters: Dict[int, List[Tuple[int, asyncio.Future]]] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._stopped = False
+
+    # -------------------------------------------------------------- public
+
+    @property
+    def node_id(self) -> int:
+        return self.core.node_id
+
+    @property
+    def is_leader(self) -> bool:
+        return self.core.role is Role.LEADER
+
+    @property
+    def leader_id(self) -> Optional[int]:
+        return self.core.leader_id
+
+    async def start(self) -> None:
+        self._tasks.append(asyncio.create_task(self._tick_loop()))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        # Snapshot: completing tasks remove themselves from the live list.
+        pending = list(self._tasks)
+        for t in pending:
+            t.cancel()
+        for t in pending:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks.clear()
+        self._fail_waiters(RuntimeError("raft node stopped"))
+        await self.transport.close()
+
+    async def propose(self, command: str, timeout: float = 10.0) -> int:
+        """Replicate `command`; resolves with its index once COMMITTED."""
+        index = self.core.propose(command, time.monotonic())
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._commit_waiters.setdefault(index, []).append(
+            (self.core.current_term, fut)
+        )
+        self._pump()
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError(f"entry {index} not committed within {timeout}s")
+
+    # RPC entry points (called by the gRPC servicer / mem transport) ------
+
+    def handle_vote_request(self, req: VoteRequest) -> VoteResponse:
+        resp = self.core.on_vote_request(req, time.monotonic())
+        self._pump()
+        return resp
+
+    def handle_append_request(self, req: AppendRequest) -> AppendResponse:
+        resp = self.core.on_append_request(req, time.monotonic())
+        self._pump()
+        return resp
+
+    # ------------------------------------------------------------ internals
+
+    async def _tick_loop(self) -> None:
+        while not self._stopped:
+            self.core.tick(time.monotonic())
+            self._pump()
+            await asyncio.sleep(self.tick_interval)
+
+    def _pump(self) -> None:
+        """Apply newly committed entries and dispatch outbound messages."""
+        for index, entry in self.core.take_applies():
+            self._resolve_waiters(index, entry)
+            if self.apply_cb is not None and entry.command != NOOP:
+                try:
+                    self.apply_cb(index, entry)
+                except Exception:
+                    log.exception("apply callback failed at index %d", index)
+        if self.core.role is not Role.LEADER:
+            self._fail_waiters(NotLeader(self.core.leader_id))
+        for peer, message in self.core.drain_outbox():
+            task = asyncio.ensure_future(self._deliver(peer, message))
+            self._tasks.append(task)
+            task.add_done_callback(self._discard_task)
+
+    async def _deliver(self, peer: int, message) -> None:
+        try:
+            resp = await self.transport.send(peer, message)
+        except Exception as e:
+            log.debug("send to %d failed: %s", peer, e)
+            return
+        now = time.monotonic()
+        if isinstance(message, VoteRequest) and isinstance(resp, VoteResponse):
+            self.core.on_vote_response(peer, resp, now)
+        elif isinstance(message, AppendRequest) and isinstance(resp, AppendResponse):
+            self.core.on_append_response(peer, resp, now)
+        self._pump()
+
+    def _discard_task(self, task: asyncio.Task) -> None:
+        try:
+            self._tasks.remove(task)
+        except ValueError:
+            pass  # stop() already cleared the list
+
+    def _resolve_waiters(self, index: int, entry: Entry) -> None:
+        for term, fut in self._commit_waiters.pop(index, []):
+            if fut.done():
+                continue
+            if entry.term == term:
+                fut.set_result(index)
+            else:
+                # A different leader's entry won this slot; ours was lost.
+                fut.set_exception(NotLeader(self.core.leader_id))
+
+    def _fail_waiters(self, exc: Exception) -> None:
+        if not self._commit_waiters:
+            return
+        for futs in self._commit_waiters.values():
+            for _, fut in futs:
+                if not fut.done():
+                    fut.set_exception(exc)
+        self._commit_waiters.clear()
+
+
+class MemTransport(Transport):
+    """In-process cluster transport with fault injection for tests.
+
+    Shared `MemNetwork` routes messages between nodes synchronously (with an
+    optional asyncio delay), supports dropping messages and partitioning
+    node sets — the deterministic-simulation harness SURVEY.md §4 calls for.
+    """
+
+    def __init__(self, network: "MemNetwork", node_id: int):
+        self.network = network
+        self.node_id = node_id
+
+    async def send(self, peer: int, message) -> object:
+        return await self.network.deliver(self.node_id, peer, message)
+
+
+class MemNetwork:
+    def __init__(self, *, delay: float = 0.0):
+        self.nodes: Dict[int, RaftNode] = {}
+        self.delay = delay
+        self.partitions: List[set] = []  # node sets that can talk internally
+        self.drop_pairs: set = set()     # directed (src, dst) pairs to drop
+
+    def register(self, node: RaftNode) -> None:
+        self.nodes[node.node_id] = node
+
+    def transport_for(self, node_id: int) -> MemTransport:
+        return MemTransport(self, node_id)
+
+    def partition(self, *groups) -> None:
+        self.partitions = [set(g) for g in groups]
+
+    def heal(self) -> None:
+        self.partitions = []
+        self.drop_pairs = set()
+
+    def _blocked(self, src: int, dst: int) -> bool:
+        if (src, dst) in self.drop_pairs:
+            return True
+        if self.partitions:
+            return not any(src in g and dst in g for g in self.partitions)
+        return False
+
+    async def deliver(self, src: int, dst: int, message) -> object:
+        if self._blocked(src, dst):
+            raise ConnectionError(f"partitioned: {src} -> {dst}")
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        node = self.nodes.get(dst)
+        if node is None or node._stopped:
+            raise ConnectionError(f"node {dst} down")
+        if isinstance(message, VoteRequest):
+            resp = node.handle_vote_request(message)
+        elif isinstance(message, AppendRequest):
+            resp = node.handle_append_request(message)
+        else:
+            raise TypeError(type(message))
+        if self._blocked(dst, src):
+            raise ConnectionError(f"partitioned: {dst} -> {src}")
+        return resp

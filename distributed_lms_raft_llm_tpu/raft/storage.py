@@ -1,0 +1,149 @@
+"""Durable Raft state: current_term, voted_for, and the log.
+
+The reference keeps all Raft state in process memory — a restarted node
+rejoins at term 0 with an empty log, violating Raft's durability assumptions
+(SURVEY.md §5 checkpoint/resume). Here every meta/log mutation is appended
+to a JSONL write-ahead file before the core sends any message that depends
+on it; recovery replays the file.
+
+Records:
+    {"t": "meta", "term": N, "voted_for": id|null}
+    {"t": "entry", "i": index, "term": N, "cmd": "..."}
+    {"t": "trunc", "i": index}          # delete entries >= index
+
+Compaction rewrites the file from live state when it grows past a bound.
+`MemoryStorage` backs deterministic tests and simulated restarts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import List, Optional, Sequence, Tuple
+
+from .messages import Entry
+
+
+class MemoryStorage:
+    """In-memory storage; survives simulated 'restarts' of a RaftCore by
+    being handed to the next incarnation."""
+
+    def __init__(self):
+        self.term = 0
+        self.voted_for: Optional[int] = None
+        self.entries: List[Entry] = []
+
+    def load(self) -> Tuple[int, Optional[int], List[Entry]]:
+        return self.term, self.voted_for, list(self.entries)
+
+    def save_meta(self, term: int, voted_for: Optional[int]) -> None:
+        self.term = term
+        self.voted_for = voted_for
+
+    def append_entries(self, first_index: int, entries: Sequence[Entry]) -> None:
+        assert first_index == len(self.entries) + 1, (first_index, len(self.entries))
+        self.entries.extend(entries)
+
+    def truncate_from(self, index: int) -> None:
+        del self.entries[index - 1 :]
+
+
+class FileStorage:
+    """JSONL WAL with periodic compaction."""
+
+    def __init__(self, path: str, *, fsync: bool = True,
+                 compact_every_bytes: int = 4 * 1024 * 1024):
+        self.path = path
+        self.fsync = fsync
+        self.compact_every_bytes = compact_every_bytes
+        self._term = 0
+        self._voted_for: Optional[int] = None
+        self._entries: List[Entry] = []
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._replay()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    # -------------------------------------------------------------- replay
+
+    def _replay(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        good_offset = 0
+        with open(self.path, "rb") as f:
+            for raw in f:
+                line = raw.decode("utf-8", errors="replace").strip()
+                if line:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail write from a crash: stop replay here
+                    kind = rec.get("t")
+                    if kind == "meta":
+                        self._term = rec["term"]
+                        self._voted_for = rec["voted_for"]
+                    elif kind == "entry":
+                        idx = rec["i"]
+                        if idx == len(self._entries) + 1:
+                            self._entries.append(
+                                Entry(term=rec["term"], command=rec["cmd"])
+                            )
+                    elif kind == "trunc":
+                        del self._entries[rec["i"] - 1 :]
+                good_offset += len(raw)
+        # Drop any torn tail so the next append starts on a clean line —
+        # otherwise the new record merges into the partial one and the
+        # *following* replay would silently lose everything after it.
+        if good_offset < os.path.getsize(self.path):
+            with open(self.path, "r+b") as f:
+                f.truncate(good_offset)
+
+    # ----------------------------------------------------------------- api
+
+    def load(self) -> Tuple[int, Optional[int], List[Entry]]:
+        return self._term, self._voted_for, list(self._entries)
+
+    def _write(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+        if self._fh.tell() > self.compact_every_bytes:
+            self._compact()
+
+    def save_meta(self, term: int, voted_for: Optional[int]) -> None:
+        self._term = term
+        self._voted_for = voted_for
+        self._write({"t": "meta", "term": term, "voted_for": voted_for})
+
+    def append_entries(self, first_index: int, entries: Sequence[Entry]) -> None:
+        for i, e in enumerate(entries):
+            idx = first_index + i
+            assert idx == len(self._entries) + 1
+            self._entries.append(e)
+            self._write({"t": "entry", "i": idx, "term": e.term, "cmd": e.command})
+
+    def truncate_from(self, index: int) -> None:
+        del self._entries[index - 1 :]
+        self._write({"t": "trunc", "i": index})
+
+    def _compact(self) -> None:
+        """Rewrite the WAL as one meta record + live entries, atomically."""
+        dir_ = os.path.dirname(os.path.abspath(self.path))
+        fd, tmp = tempfile.mkstemp(dir=dir_, prefix=".raftwal.")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(json.dumps(
+                {"t": "meta", "term": self._term, "voted_for": self._voted_for}
+            ) + "\n")
+            for i, e in enumerate(self._entries, start=1):
+                f.write(json.dumps(
+                    {"t": "entry", "i": i, "term": e.term, "cmd": e.command}
+                ) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._fh.close()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        self._fh.close()

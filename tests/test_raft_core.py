@@ -1,0 +1,158 @@
+"""Deterministic sans-IO Raft core tests: no clocks, no sockets, no sleeps."""
+
+import pytest
+
+from distributed_lms_raft_llm_tpu.raft import (
+    AppendRequest,
+    Entry,
+    NotLeader,
+    RaftConfig,
+    RaftCore,
+    Role,
+    VoteRequest,
+    MemoryStorage,
+)
+from distributed_lms_raft_llm_tpu.raft.messages import NOOP
+
+
+CFG = RaftConfig(
+    election_timeout_min=1.0, election_timeout_max=1.0, heartbeat_interval=0.4
+)
+
+
+def make(node_id=1, peers=(1, 2, 3), storage=None):
+    return RaftCore(node_id, peers, storage or MemoryStorage(), CFG, now=0.0)
+
+
+def test_follower_times_out_and_starts_election():
+    c = make()
+    c.tick(0.5)
+    assert c.role is Role.FOLLOWER and not c.outbox
+    c.tick(1.1)
+    assert c.role is Role.CANDIDATE
+    assert c.current_term == 1
+    assert c.voted_for == 1
+    peers_messaged = {p for p, m in c.outbox if isinstance(m, VoteRequest)}
+    assert peers_messaged == {2, 3}
+
+
+def test_vote_granted_once_per_term():
+    c = make(node_id=2)
+    req = VoteRequest(term=1, candidate_id=1, last_log_index=0, last_log_term=0)
+    assert c.on_vote_request(req, 0.1).granted
+    # Same candidate asks again (retry): still granted.
+    assert c.on_vote_request(req, 0.2).granted
+    # Different candidate, same term: denied.
+    other = VoteRequest(term=1, candidate_id=3, last_log_index=0, last_log_term=0)
+    assert not c.on_vote_request(other, 0.3).granted
+
+
+def test_vote_denied_to_stale_log():
+    storage = MemoryStorage()
+    storage.entries = [Entry(term=2, command="x")]
+    storage.term = 2
+    c = make(node_id=2, storage=storage)
+    stale = VoteRequest(term=3, candidate_id=1, last_log_index=0, last_log_term=0)
+    assert not c.on_vote_request(stale, 0.1).granted
+    fresh = VoteRequest(term=3, candidate_id=3, last_log_index=1, last_log_term=2)
+    assert c.on_vote_request(fresh, 0.2).granted
+
+
+def test_candidate_becomes_leader_on_quorum_and_appends_noop():
+    c = make()
+    c.tick(1.1)
+    from distributed_lms_raft_llm_tpu.raft import VoteResponse
+
+    c.on_vote_response(2, VoteResponse(term=1, granted=True), 1.2)
+    assert c.role is Role.LEADER
+    assert c.log[-1].command == NOOP
+    # next_index points at the first entry each peer lacks — here the just-
+    # appended noop (the reference's D2 off-by-one skipped the first entry).
+    assert all(v == c.last_log_index for v in c.next_index.values())
+    outgoing = [m for _, m in c.outbox if isinstance(m, AppendRequest)]
+    assert outgoing and all(
+        m.entries and m.entries[-1].command == NOOP for m in outgoing
+    )
+
+
+def test_append_rejects_stale_term_and_accepts_current():
+    c = make(node_id=2)
+    ok = c.on_append_request(
+        AppendRequest(term=1, leader_id=1, prev_log_index=0, prev_log_term=0,
+                      entries=(), leader_commit=0),
+        0.1,
+    )
+    assert ok.success and c.leader_id == 1
+    stale = c.on_append_request(
+        AppendRequest(term=0, leader_id=3, prev_log_index=0, prev_log_term=0,
+                      entries=(), leader_commit=0),
+        0.2,
+    )
+    assert not stale.success and stale.term == 1
+
+
+def test_append_conflict_truncates_and_reports_hint():
+    c = make(node_id=2)
+    # Install entries from term 1.
+    c.on_append_request(
+        AppendRequest(term=1, leader_id=1, prev_log_index=0, prev_log_term=0,
+                      entries=(Entry(1, "a"), Entry(1, "b"), Entry(1, "c")),
+                      leader_commit=0),
+        0.1,
+    )
+    assert c.last_log_index == 3
+    # New leader (term 3) has a different entry at index 2.
+    resp = c.on_append_request(
+        AppendRequest(term=3, leader_id=3, prev_log_index=2, prev_log_term=2,
+                      entries=(), leader_commit=0),
+        0.2,
+    )
+    assert not resp.success
+    assert resp.conflict_index == 1  # whole term-1 run reported for fast skip
+    resp = c.on_append_request(
+        AppendRequest(term=3, leader_id=3, prev_log_index=1, prev_log_term=1,
+                      entries=(Entry(3, "x"),), leader_commit=0),
+        0.3,
+    )
+    assert resp.success
+    assert [e.command for e in c.log] == ["a", "x"]
+
+
+def test_commit_requires_majority_and_current_term():
+    c = make()
+    c.tick(1.1)
+    from distributed_lms_raft_llm_tpu.raft import VoteResponse, AppendResponse
+
+    c.on_vote_response(2, VoteResponse(term=1, granted=True), 1.2)
+    assert c.role is Role.LEADER
+    idx = c.propose("cmd", 1.3)  # index 2 (after the noop)
+    assert c.commit_index == 0
+    c.on_append_response(2, AppendResponse(term=1, success=True, match_index=idx), 1.4)
+    assert c.commit_index == idx  # leader + one peer = quorum of 3
+    applied = c.take_applies()
+    assert [e.command for _, e in applied][-1] == "cmd"
+
+
+def test_propose_on_follower_raises_not_leader():
+    c = make()
+    with pytest.raises(NotLeader):
+        c.propose("cmd", 0.1)
+
+
+def test_step_down_on_higher_term_response():
+    c = make()
+    c.tick(1.1)
+    from distributed_lms_raft_llm_tpu.raft import VoteResponse
+
+    c.on_vote_response(2, VoteResponse(term=5, granted=False), 1.2)
+    assert c.role is Role.FOLLOWER
+    assert c.current_term == 5
+
+
+def test_restart_recovers_persistent_state():
+    storage = MemoryStorage()
+    c = make(storage=storage)
+    c.tick(1.1)  # term -> 1, votes for self
+    incarnation2 = make(storage=storage)
+    assert incarnation2.current_term == 1
+    assert incarnation2.voted_for == 1
