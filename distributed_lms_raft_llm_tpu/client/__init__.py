@@ -1,0 +1,3 @@
+"""LMS clients: leader-discovering library + CLI."""
+
+from .client import LMSClient, NoLeader  # noqa: F401

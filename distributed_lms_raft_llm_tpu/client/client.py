@@ -1,0 +1,252 @@
+"""Leader-discovering LMS client library.
+
+Reference behavior (GUI_RAFT_LLM_SourceCode/lms_gui_final.py:64-155): poll
+`RaftService.WhoIsLeader` across all servers (≤5 rounds, 3 s backoff),
+follow redirects to the named leader, and on transient RPC failures
+re-resolve the leader and retry (≤3). Reimplemented as a clean synchronous
+library the CLI/GUI layers (and tests) share, with channel reuse instead of
+per-call dialing.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Sequence, TypeVar
+
+import grpc
+
+from ..proto import lms_pb2, rpc
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+RETRYABLE = {
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.UNKNOWN,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.CANCELLED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+}
+
+
+class NoLeader(Exception):
+    pass
+
+
+class LMSClient:
+    def __init__(
+        self,
+        servers: Sequence[str],
+        *,
+        discovery_rounds: int = 5,
+        discovery_backoff_s: float = 1.0,
+        rpc_retries: int = 3,
+        rpc_timeout: float = 30.0,
+    ):
+        self.servers = list(servers)
+        self.discovery_rounds = discovery_rounds
+        self.discovery_backoff_s = discovery_backoff_s
+        self.rpc_retries = rpc_retries
+        self.rpc_timeout = rpc_timeout
+        self.token: Optional[str] = None
+        self.role: Optional[str] = None
+        self._channels: Dict[str, grpc.Channel] = {}
+        self._leader_addr: Optional[str] = None
+
+    # ------------------------------------------------------------ plumbing
+
+    def _channel(self, addr: str) -> grpc.Channel:
+        if addr not in self._channels:
+            self._channels[addr] = grpc.insecure_channel(
+                addr,
+                options=[
+                    ("grpc.max_send_message_length", 50 * 1024 * 1024),
+                    ("grpc.max_receive_message_length", 50 * 1024 * 1024),
+                ],
+            )
+        return self._channels[addr]
+
+    def close(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+
+    def discover_leader(self, force: bool = False) -> str:
+        """Address of the current leader (cached until an RPC fails)."""
+        if self._leader_addr and not force:
+            return self._leader_addr
+        for attempt in range(self.discovery_rounds):
+            for addr in self.servers:
+                try:
+                    stub = rpc.RaftServiceStub(self._channel(addr))
+                    resp = stub.GetLeader(lms_pb2.GetLeaderRequest(), timeout=2)
+                    if resp.nodeId > 0 and resp.nodeAddress:
+                        self._leader_addr = resp.nodeAddress
+                        return self._leader_addr
+                    who = stub.WhoIsLeader(lms_pb2.Empty(), timeout=2)
+                    if 0 < who.leader_id <= len(self.servers):
+                        self._leader_addr = self.servers[who.leader_id - 1]
+                        return self._leader_addr
+                except grpc.RpcError:
+                    continue
+            time.sleep(self.discovery_backoff_s)
+        raise NoLeader(f"no leader found among {self.servers}")
+
+    def _call(self, fn: Callable[[rpc.LMSStub], T]) -> T:
+        """Run an op against the leader; re-resolve + retry on transients."""
+        last_error: Optional[Exception] = None
+        for attempt in range(self.rpc_retries + 1):
+            try:
+                addr = self.discover_leader(force=attempt > 0)
+                stub = rpc.LMSStub(self._channel(addr))
+                return fn(stub)
+            except grpc.RpcError as e:
+                last_error = e
+                if e.code() not in RETRYABLE:
+                    raise
+                log.info("rpc failed (%s); re-resolving leader", e.code())
+        raise last_error  # type: ignore[misc]
+
+    # ----------------------------------------------------------------- api
+
+    def register(self, username: str, password: str, role: str):
+        return self._call(
+            lambda s: s.Register(
+                lms_pb2.RegisterRequest(
+                    username=username, password=password, role=role
+                ),
+                timeout=self.rpc_timeout,
+            )
+        )
+
+    def login(self, username: str, password: str) -> bool:
+        resp = self._call(
+            lambda s: s.Login(
+                lms_pb2.LoginRequest(username=username, password=password),
+                timeout=self.rpc_timeout,
+            )
+        )
+        if resp.success:
+            self.token = resp.token
+            self.role = resp.role
+        return resp.success
+
+    def logout(self) -> bool:
+        if not self.token:
+            return False
+        resp = self._call(
+            lambda s: s.Logout(
+                lms_pb2.LogoutRequest(token=self.token), timeout=self.rpc_timeout
+            )
+        )
+        if resp.success:
+            self.token = None
+            self.role = None
+        return resp.success
+
+    def upload_assignment(self, filename: str, content: bytes) -> bool:
+        return self._call(
+            lambda s: s.Post(
+                lms_pb2.PostRequest(
+                    token=self.token or "", type="assignment",
+                    file=content, filename=filename,
+                ),
+                timeout=self.rpc_timeout,
+            )
+        ).success
+
+    def upload_course_material(self, filename: str, content: bytes) -> bool:
+        return self._call(
+            lambda s: s.Post(
+                lms_pb2.PostRequest(
+                    token=self.token or "", type="course_material",
+                    file=content, filename=filename,
+                ),
+                timeout=self.rpc_timeout,
+            )
+        ).success
+
+    def ask_instructor(self, query: str) -> bool:
+        return self._call(
+            lambda s: s.Post(
+                lms_pb2.PostRequest(
+                    token=self.token or "", type="query", data=query
+                ),
+                timeout=self.rpc_timeout,
+            )
+        ).success
+
+    def course_materials(self) -> List[lms_pb2.DataEntry]:
+        resp = self._call(
+            lambda s: s.Get(
+                lms_pb2.GetRequest(token=self.token or "", type="course_material"),
+                timeout=self.rpc_timeout,
+            )
+        )
+        return list(resp.entries)
+
+    def student_assignments(self) -> List[lms_pb2.DataEntry]:
+        resp = self._call(
+            lambda s: s.Get(
+                lms_pb2.GetRequest(token=self.token or "", type="student_list"),
+                timeout=self.rpc_timeout,
+            )
+        )
+        return list(resp.entries)
+
+    def grade(self, student: str, grade: str):
+        return self._call(
+            lambda s: s.GradeAssignment(
+                lms_pb2.GradeRequest(
+                    token=self.token or "", studentId=student, grade=grade
+                ),
+                timeout=self.rpc_timeout,
+            )
+        )
+
+    def my_grade(self) -> str:
+        resp = self._call(
+            lambda s: s.GetGrade(
+                lms_pb2.GetGradeRequest(token=self.token or ""),
+                timeout=self.rpc_timeout,
+            )
+        )
+        return resp.grade
+
+    def unanswered_queries(self) -> List[lms_pb2.DataEntry]:
+        resp = self._call(
+            lambda s: s.GetUnansweredQueries(
+                lms_pb2.GetRequest(token=self.token or ""),
+                timeout=self.rpc_timeout,
+            )
+        )
+        return list(resp.entries)
+
+    def respond_to_query(self, student: str, response: str) -> bool:
+        return self._call(
+            lambda s: s.RespondToQuery(
+                lms_pb2.PostRequest(
+                    token=self.token or "", studentId=student, data=response
+                ),
+                timeout=self.rpc_timeout,
+            )
+        ).success
+
+    def instructor_responses(self) -> List[lms_pb2.DataEntry]:
+        resp = self._call(
+            lambda s: s.GetInstructorResponse(
+                lms_pb2.GetRequest(token=self.token or ""),
+                timeout=self.rpc_timeout,
+            )
+        )
+        return list(resp.entries)
+
+    def ask_llm(self, query: str) -> lms_pb2.QueryResponse:
+        return self._call(
+            lambda s: s.GetLLMAnswer(
+                lms_pb2.QueryRequest(token=self.token or "", query=query),
+                timeout=max(self.rpc_timeout, 120.0),
+            )
+        )

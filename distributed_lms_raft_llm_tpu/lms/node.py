@@ -1,0 +1,107 @@
+"""LMSNode: one LMS cluster member — Raft node + state machine + stores.
+
+Composition (reference equivalent: the `serve()` wiring of LMSService ↔
+RaftService ↔ FileTransferServicer, GUI_RAFT_LLM_SourceCode/
+lms_server.py:1561-1601):
+
+    RaftNode (asyncio, durable WAL)
+      └─ apply ─► LMSState.apply(op, args)
+                   ├─ SnapshotStore.save(state, applied_index)
+                   └─ leader: schedule blob push to followers (uploads)
+
+Boot order: restore snapshot → construct RaftCore with last_applied at the
+snapshot index → WAL suffix replays through the same apply path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Dict, Optional
+
+from ..raft import FileStorage, RaftConfig, RaftNode, decode_command
+from ..raft.grpc_transport import GrpcTransport
+from ..raft.messages import Entry
+from .persistence import BlobStore, SnapshotStore
+from .service import replicate_file_to_peers
+from .state import LMSState
+
+log = logging.getLogger(__name__)
+
+
+class LMSNode:
+    def __init__(
+        self,
+        node_id: int,
+        addresses: Dict[int, str],
+        data_dir: str,
+        *,
+        raft_config: Optional[RaftConfig] = None,
+        transport=None,
+        snapshot_every: int = 64,
+    ):
+        # snapshot_every > 1 amortizes the full-state JSON rewrite (the WAL
+        # already guarantees durability; on crash, at most snapshot_every
+        # entries replay). The reference rewrote everything per command.
+        self.node_id = node_id
+        self.addresses = dict(addresses)
+        os.makedirs(data_dir, exist_ok=True)
+        self.snapshots = SnapshotStore(os.path.join(data_dir, "lms_data.json"))
+        self.blobs = BlobStore(os.path.join(data_dir, "uploads"))
+        self.state, applied = self.snapshots.load()
+        self.snapshot_every = max(1, snapshot_every)
+        self._applies_since_snapshot = 0
+        self._last_applied_index = applied
+
+        storage = FileStorage(os.path.join(data_dir, "raft_wal.jsonl"))
+        transport = transport or GrpcTransport(self.addresses)
+        self.node = RaftNode(
+            node_id,
+            list(self.addresses),
+            storage,
+            transport,
+            apply_cb=self._apply,
+            config=raft_config,
+            last_applied=applied,
+        )
+
+    # ------------------------------------------------------------------ api
+
+    async def start(self) -> None:
+        await self.node.start()
+
+    async def stop(self) -> None:
+        await self.node.stop()
+        self.snapshots.save(self.state, self._last_applied_index)
+
+    # ------------------------------------------------------------ internals
+
+    def _apply(self, index: int, entry: Entry) -> None:
+        op, args = decode_command(entry.command)
+        self.state.apply(op, args)
+        self._last_applied_index = index
+        self._applies_since_snapshot += 1
+        if self._applies_since_snapshot >= self.snapshot_every:
+            self.snapshots.save(self.state, index)
+            self._applies_since_snapshot = 0
+        # Bulk data plane: after the metadata commits, the leader streams the
+        # file itself to followers (reference lms_server.py:1328-1334).
+        if op in ("PostAssignment", "PostCourseMaterial") and self.node.is_leader:
+            rel = args["filepath"]
+            task = asyncio.ensure_future(
+                replicate_file_to_peers(
+                    self.addresses, self.node_id, self.blobs, rel
+                )
+            )
+            task.add_done_callback(_log_replication_result)
+
+
+def _log_replication_result(task: asyncio.Task) -> None:
+    try:
+        results = task.result()
+    except Exception as e:  # pragma: no cover - network dependent
+        log.warning("file replication task failed: %s", e)
+        return
+    if results:
+        log.info("file replicated: %s", results)

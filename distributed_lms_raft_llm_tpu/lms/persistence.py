@@ -1,0 +1,116 @@
+"""LMS snapshot persistence + the PDF blob store.
+
+Parity target: the reference rewrites `lms_data.json` after every applied
+command and keeps PDFs under `uploads/` (reference:
+GUI_RAFT_LLM_SourceCode/lms_server.py:30-92, 312). Here:
+
+- the snapshot additionally records `applied_index`, so on boot the node
+  restores the snapshot and Raft replays only the WAL suffix after it
+  (the reference had no Raft durability at all);
+- writes are atomic (tmp + rename) instead of in-place truncation;
+- the blob store confines paths to its root (the reference wrote whatever
+  `destination_path` a peer sent — path traversal by design).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+from .state import LMSState
+
+
+class SnapshotStore:
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def load(self) -> Tuple[LMSState, int]:
+        """(state, applied_index) — empty state at index 0 when absent."""
+        if not os.path.exists(self.path):
+            return LMSState(), 0
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                obj = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return LMSState(), 0
+        return LMSState(obj.get("data", {})), int(obj.get("applied_index", 0))
+
+    def save(self, state: LMSState, applied_index: int) -> None:
+        payload = {"applied_index": applied_index, "data": state.data}
+        dir_ = os.path.dirname(os.path.abspath(self.path))
+        fd, tmp = tempfile.mkstemp(dir=dir_, prefix=".lmssnap.")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+
+class BlobStore:
+    """PDF files under one root; all paths are stored and exchanged relative
+    to it (wire `destination_path` stays inside the root on every node)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _resolve(self, rel_path: str) -> str:
+        full = os.path.abspath(os.path.join(self.root, rel_path))
+        if not full.startswith(self.root + os.sep) and full != self.root:
+            raise ValueError(f"path escapes blob root: {rel_path!r}")
+        return full
+
+    def put(self, rel_path: str, data: bytes) -> str:
+        full = self._resolve(rel_path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(full), prefix=".blob.")
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, full)
+        return full
+
+    def get(self, rel_path: str) -> Optional[bytes]:
+        full = self._resolve(rel_path)
+        if not os.path.exists(full):
+            return None
+        with open(full, "rb") as f:
+            return f.read()
+
+    def exists(self, rel_path: str) -> bool:
+        return os.path.exists(self._resolve(rel_path))
+
+    def open_writer(self, rel_path: str):
+        """Streaming writer for chunked replication: collects chunks into a
+        temp file and renames on close (re-sent files replace, never append —
+        the reference appended with 'ab', duplicating content on resend,
+        defect D5)."""
+        full = self._resolve(rel_path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        return _BlobWriter(full)
+
+
+class _BlobWriter:
+    def __init__(self, final_path: str):
+        self.final_path = final_path
+        fd, self._tmp = tempfile.mkstemp(
+            dir=os.path.dirname(final_path), prefix=".blobstream."
+        )
+        self._f = os.fdopen(fd, "wb")
+        self.bytes_written = 0
+
+    def write(self, chunk: bytes) -> None:
+        self._f.write(chunk)
+        self.bytes_written += len(chunk)
+
+    def commit(self) -> None:
+        self._f.close()
+        os.replace(self._tmp, self.final_path)
+
+    def abort(self) -> None:
+        self._f.close()
+        if os.path.exists(self._tmp):
+            os.unlink(self._tmp)

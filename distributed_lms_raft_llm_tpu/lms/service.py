@@ -1,0 +1,474 @@
+"""LMS application service: the 12 `LMS` RPCs + file replication.
+
+Behavioral parity with the reference handlers (reference:
+GUI_RAFT_LLM_SourceCode/lms_server.py:708-1521) with the surveyed defects
+fixed:
+
+- every mutation is `await propose(...)`d and ACKed only after quorum
+  COMMIT (reference returned success immediately after proposing, D9);
+- sessions are part of the replicated state, so logins survive failover
+  (D7): Login/Logout are Raft commands carrying the token minted by the
+  leader;
+- `WhoIsLeader` is implemented on the LMS service as declared in the
+  contract (D6) as well as on RaftService;
+- uploads replicate leader→followers via `FileTransferService.SendFile`
+  with replace-not-append semantics and path confinement (D5);
+- the BERT gate is a long-lived engine object, not a per-request model load
+  (D4), and the tutoring channel is dialed once.
+
+Read RPCs serve from the local replica (the client routes them to the
+leader, same as the reference).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import uuid
+from typing import Dict, Optional
+
+import grpc
+
+from ..proto import lms_pb2, rpc
+from ..raft import NotLeader, encode_command
+from ..utils import pdf
+from ..utils.metrics import Metrics
+from .persistence import BlobStore
+from .state import LMSState, hash_password
+
+log = logging.getLogger(__name__)
+
+CHUNK_SIZE = 1024 * 1024  # reference streams 1 MB chunks (lms_server.py:1467)
+
+
+class LMSServicer(rpc.LMSServicer):
+    def __init__(
+        self,
+        node,                      # raft.RaftNode
+        state: LMSState,
+        blobs: BlobStore,
+        *,
+        gate=None,                 # engine.RelevanceGate (optional)
+        tutoring_address: Optional[str] = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.node = node
+        self.state = state
+        self.blobs = blobs
+        self.gate = gate
+        self.metrics = metrics or Metrics()
+        self._tutoring_address = tutoring_address
+        self._tutoring_channel: Optional[grpc.aio.Channel] = None
+        self._tutoring_stub = None
+
+    # ------------------------------------------------------------- helpers
+
+    def _auth(self, token: str):
+        """(username, role) or None."""
+        username = self.state.user_of_token(token)
+        if username is None:
+            return None
+        return username, self.state.role_of(username)
+
+    async def _propose(self, op: str, args: dict, context) -> bool:
+        """Propose and await commit. Not-leader/timeout conditions abort the
+        RPC with UNAVAILABLE — which the reference client already treats as
+        're-resolve the leader and retry' (lms_gui_final.py:140-146) — so
+        stale-leader clients recover instead of seeing terminal app-level
+        failures."""
+        try:
+            await self.node.propose(encode_command(op, args))
+            return True
+        except (NotLeader, TimeoutError, RuntimeError) as e:
+            log.info("propose %s failed: %s", op, e)
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"not the leader or no quorum ({e}); re-resolve and retry",
+            )
+            return False  # unreachable; abort raises
+
+    def _tutoring(self):
+        if self._tutoring_stub is None:
+            if not self._tutoring_address:
+                return None
+            self._tutoring_channel = grpc.aio.insecure_channel(
+                self._tutoring_address
+            )
+            self._tutoring_stub = rpc.TutoringStub(self._tutoring_channel)
+        return self._tutoring_stub
+
+    # ---------------------------------------------------------------- auth
+
+    async def Register(self, request, context):
+        self.metrics.inc("register")
+        if not request.username or not request.password:
+            return lms_pb2.RegisterResponse(
+                success=False, message="Username and password are required."
+            )
+        if request.role not in ("student", "instructor"):
+            return lms_pb2.RegisterResponse(
+                success=False, message="Role must be student or instructor."
+            )
+        if request.username in self.state.data["users"]:
+            return lms_pb2.RegisterResponse(
+                success=False, message=f"User {request.username} already exists."
+            )
+        pw_hash = hash_password(request.password)
+        await self._propose(
+            "Register",
+            {
+                "username": request.username,
+                "password_hash": pw_hash,
+                "role": request.role,
+            },
+            context,
+        )
+        # Re-check after commit: with concurrent registrations of the same
+        # name, the applier is first-writer-wins — only tell the winner it
+        # succeeded.
+        won = self.state.data["users"].get(request.username, {}).get(
+            "password"
+        ) == pw_hash
+        msg = (
+            f"User {request.username} registered as {request.role}."
+            if won
+            else f"User {request.username} already exists."
+        )
+        return lms_pb2.RegisterResponse(success=won, message=msg)
+
+    async def Login(self, request, context):
+        self.metrics.inc("login")
+        if not self.state.check_password(request.username, request.password):
+            return lms_pb2.LoginResponse(success=False)
+        token = uuid.uuid4().hex
+        await self._propose(
+            "Login", {"username": request.username, "token": token}, context
+        )
+        role = self.state.role_of(request.username) or ""
+        return lms_pb2.LoginResponse(success=True, token=token, role=role)
+
+    async def Logout(self, request, context):
+        if self.state.user_of_token(request.token) is None:
+            return lms_pb2.LogoutResponse(success=False)
+        ok = await self._propose("Logout", {"token": request.token}, context)
+        return lms_pb2.LogoutResponse(success=ok)
+
+    # --------------------------------------------------------------- writes
+
+    async def Post(self, request, context):
+        auth = self._auth(request.token)
+        if auth is None:
+            return lms_pb2.PostResponse(success=False)
+        username, role = auth
+        self.metrics.inc("post")
+
+        loop = asyncio.get_running_loop()
+        # Stored/echoed filenames are basenamed: a hostile client must not be
+        # able to plant "../" paths that peers or downloading clients write.
+        filename = os.path.basename(request.filename)
+
+        if role == "instructor" and request.type == "course_material":
+            rel = os.path.join("materials", filename)
+            # File IO off-loop: this loop also drives Raft ticks/heartbeats.
+            await loop.run_in_executor(None, self.blobs.put, rel, request.file)
+            ok = await self._propose(
+                "PostCourseMaterial",
+                {"instructor": username, "filename": filename,
+                 "filepath": rel},
+                context,
+            )
+            return lms_pb2.PostResponse(success=ok)
+
+        if role == "student" and request.type == "assignment":
+            rel = os.path.join("assignments", username, filename)
+            await loop.run_in_executor(None, self.blobs.put, rel, request.file)
+            # CPU-bound (zlib + regex over up to 50 MB): keep off-loop too.
+            text = await loop.run_in_executor(
+                None, pdf.extract_text, request.file
+            )
+            ok = await self._propose(
+                "PostAssignment",
+                {"student": username, "filename": filename,
+                 "filepath": rel, "text": text},
+                context,
+            )
+            return lms_pb2.PostResponse(success=ok)
+
+        if role == "student" and request.type == "query":
+            ok = await self._propose(
+                "AskQuery", {"username": username, "query": request.data},
+                context,
+            )
+            return lms_pb2.PostResponse(success=ok)
+
+        return lms_pb2.PostResponse(success=False)
+
+    async def GradeAssignment(self, request, context):
+        auth = self._auth(request.token)
+        if auth is None:
+            return lms_pb2.GradeResponse(
+                success=False, message="Invalid session token"
+            )
+        _, role = auth
+        if role != "instructor":
+            return lms_pb2.GradeResponse(
+                success=False, message="Only instructors can grade assignments"
+            )
+        if request.studentId not in self.state.data["assignments"]:
+            return lms_pb2.GradeResponse(
+                success=False, message="Student assignment not found"
+            )
+        ok = await self._propose(
+            "GradeAssignment",
+            {"student": request.studentId, "grade": request.grade},
+            context,
+        )
+        msg = "Grade recorded." if ok else "Grading failed (no leader?)."
+        return lms_pb2.GradeResponse(success=ok, message=msg)
+
+    async def RespondToQuery(self, request, grpc_context):
+        auth = self._auth(request.token)
+        if auth is None:
+            return lms_pb2.PostResponse(success=False)
+        username, role = auth
+        if role != "instructor":
+            return lms_pb2.PostResponse(success=False)
+        ok = await self._propose(
+            "RespondToQuery",
+            {"instructor": username, "student": request.studentId,
+             "response": request.data},
+            grpc_context,
+        )
+        return lms_pb2.PostResponse(success=ok)
+
+    # ---------------------------------------------------------------- reads
+
+    async def Get(self, request, context):
+        auth = self._auth(request.token)
+        if auth is None:
+            return lms_pb2.GetResponse(success=False)
+        username, role = auth
+        entries = []
+
+        if request.type == "course_material" and role == "student":
+            materials = self.state.data["course_materials"]
+            if not materials:
+                return lms_pb2.GetResponse(
+                    success=True, message="No course materials available."
+                )
+            loop = asyncio.get_running_loop()
+            for material in materials:
+                content = await loop.run_in_executor(
+                    None, self.blobs.get, material["filepath"]
+                ) or b""
+                entries.append(
+                    lms_pb2.DataEntry(
+                        id="1",
+                        filename=material["filename"],
+                        file=content,
+                        instructor=material.get("instructor", "Unknown"),
+                    )
+                )
+            return lms_pb2.GetResponse(success=True, entries=entries)
+
+        if request.type == "student_list" and role == "instructor":
+            loop = asyncio.get_running_loop()
+            for student, assignments in self.state.data["assignments"].items():
+                for assignment in assignments:
+                    content = await loop.run_in_executor(
+                        None, self.blobs.get, assignment["filepath"]
+                    ) or b""
+                    entries.append(
+                        lms_pb2.DataEntry(
+                            id=student,
+                            filename=assignment["filename"],
+                            file=content,
+                        )
+                    )
+            return lms_pb2.GetResponse(success=True, entries=entries)
+
+        return lms_pb2.GetResponse(
+            success=False, message="Invalid request type or unauthorized access"
+        )
+
+    async def GetGrade(self, request, context):
+        auth = self._auth(request.token)
+        if auth is None:
+            return lms_pb2.GetGradeResponse(success=False, grade="Invalid session")
+        username, role = auth
+        if role != "student":
+            return lms_pb2.GetGradeResponse(
+                success=False, grade="Only students can view grades"
+            )
+        assignments = self.state.assignments_of(username)
+        if not assignments:
+            return lms_pb2.GetGradeResponse(
+                success=True, grade="No assignments found for this student."
+            )
+        for assignment in assignments:
+            if assignment.get("grade") is not None:
+                return lms_pb2.GetGradeResponse(
+                    success=True, grade=f"Your grade: {assignment['grade']}"
+                )
+        return lms_pb2.GetGradeResponse(success=True, grade="No grade assigned yet.")
+
+    async def GetUnansweredQueries(self, request, grpc_context):
+        auth = self._auth(request.token)
+        if auth is None or auth[1] != "instructor":
+            return lms_pb2.GetResponse(success=False)
+        entries = [
+            lms_pb2.DataEntry(id=item["student"], data=item["query"])
+            for item in self.state.unanswered_queries()
+        ]
+        return lms_pb2.GetResponse(success=True, entries=entries)
+
+    async def GetInstructorResponse(self, request, grpc_context):
+        auth = self._auth(request.token)
+        if auth is None or auth[1] != "student":
+            return lms_pb2.GetResponse(success=False)
+        username = auth[0]
+        entries = [
+            lms_pb2.DataEntry(
+                id=username,
+                data=(
+                    f"Your Query: {item['query']}\n"
+                    f"Instructor Response: {item['response']}"
+                ),
+            )
+            for item in self.state.answered_queries_of(username)
+        ]
+        return lms_pb2.GetResponse(success=True, entries=entries)
+
+    # ------------------------------------------------------------ LLM path
+
+    async def GetLLMAnswer(self, request, context):
+        self.metrics.inc("llm_requests")
+        auth = self._auth(request.token)
+        if auth is None:
+            return lms_pb2.QueryResponse(success=False, response="Invalid session")
+        username, role = auth
+        if role != "student":
+            return lms_pb2.QueryResponse(
+                success=False, response="Only students can query the LLM tutor"
+            )
+        assignments = self.state.assignments_of(username)
+        if not assignments:
+            return lms_pb2.QueryResponse(
+                success=False,
+                response="Upload an assignment before asking the LLM tutor.",
+            )
+        with self.metrics.time("llm_ttft"):
+            if self.gate is not None:
+                assignment_text = assignments[0].get("text") or ""
+                loop = asyncio.get_running_loop()
+                passed, sim = await loop.run_in_executor(
+                    None, self.gate.check, request.query, assignment_text
+                )
+                self.metrics.inc("gate_pass" if passed else "gate_reject")
+                if not passed:
+                    return lms_pb2.QueryResponse(
+                        success=True,
+                        response=(
+                            "Your query does not appear related to your "
+                            f"assignment (similarity {sim:.2f}); please ask "
+                            "your instructor instead."
+                        ),
+                    )
+            stub = self._tutoring()
+            if stub is None:
+                return lms_pb2.QueryResponse(
+                    success=False, response="Tutoring service not configured."
+                )
+            try:
+                answer = await stub.GetLLMAnswer(
+                    lms_pb2.QueryRequest(token=request.token, query=request.query),
+                    timeout=120,
+                )
+            except grpc.RpcError as e:
+                log.warning("tutoring RPC failed: %s", e)
+                return lms_pb2.QueryResponse(
+                    success=False, response="The tutoring service is unavailable."
+                )
+        return answer
+
+    async def WhoIsLeader(self, request, context):
+        # Implemented on LMS as the contract declares (reference D6 left it
+        # UNIMPLEMENTED and clients had to use the RaftService one).
+        leader = self.node.leader_id
+        return lms_pb2.LeaderResponse(leader_id=leader if leader is not None else -1)
+
+
+class FileTransferServicer(rpc.FileTransferServiceServicer):
+    """Bulk data plane: receives leader-streamed uploads on followers."""
+
+    def __init__(self, blobs: BlobStore):
+        self.blobs = blobs
+
+    async def SendFile(self, request_iterator, context):
+        writer = None
+        try:
+            async for chunk in request_iterator:
+                if writer is None:
+                    writer = self.blobs.open_writer(chunk.destination_path)
+                writer.write(chunk.content)
+            if writer is None:
+                return lms_pb2.FileTransferResponse(status="error: empty stream")
+            writer.commit()
+            return lms_pb2.FileTransferResponse(status="success")
+        except Exception as e:
+            if writer is not None:
+                writer.abort()
+            log.warning("SendFile failed: %s", e)
+            return lms_pb2.FileTransferResponse(status=f"error: {e}")
+
+    async def ReplicateData(self, request, context):
+        """Direct blob push (metadata rides Raft; this is the bulk path)."""
+        try:
+            sub = "materials" if request.type == "material" else os.path.join(
+                "assignments", request.username or "unknown"
+            )
+            rel = os.path.join(sub, os.path.basename(request.filename))
+            self.blobs.put(rel, request.file_content)
+            return lms_pb2.ReplicateDataResponse(success=True)
+        except Exception as e:
+            log.warning("ReplicateData failed: %s", e)
+            return lms_pb2.ReplicateDataResponse(success=False)
+
+
+async def replicate_file_to_peers(
+    addresses: Dict[int, str],
+    self_id: int,
+    blobs: BlobStore,
+    rel_path: str,
+) -> Dict[int, str]:
+    """Leader-side: stream one blob to every peer in 1 MB chunks.
+
+    Returns {peer_id: status}. Failures are logged, not fatal — a follower
+    that missed a file can refetch via ReplicateData or serve metadata-only
+    (the reference aborted the apply on replication errors).
+    """
+    data = blobs.get(rel_path)
+    if data is None:
+        return {}
+    results: Dict[int, str] = {}
+    for peer, addr in addresses.items():
+        if peer == self_id:
+            continue
+        try:
+            async with grpc.aio.insecure_channel(addr) as channel:
+                stub = rpc.FileTransferServiceStub(channel)
+
+                async def chunks():
+                    for off in range(0, len(data), CHUNK_SIZE):
+                        yield lms_pb2.FileChunk(
+                            content=data[off : off + CHUNK_SIZE],
+                            destination_path=rel_path,
+                        )
+
+                resp = await stub.SendFile(chunks(), timeout=30)
+                results[peer] = resp.status
+        except grpc.RpcError as e:
+            results[peer] = f"error: {e.code()}"
+            log.info("file replication to %d failed: %s", peer, e.code())
+    return results

@@ -1,0 +1,150 @@
+"""The replicated LMS state machine: pure apply functions over a dict.
+
+Schema mirrors the reference's `lms_data.json` (reference:
+GUI_RAFT_LLM_SourceCode/lms_server.py:44-49 and appliers :1277-1448):
+
+    users:            {username: {password, role}}
+    assignments:      {student: [{filename, filepath, grade, text}]}
+    course_materials: [{filename, filepath, instructor}]
+    queries:          {student: [{query, answered, response}]}
+    sessions:         {token: username}     # NEW: replicated (reference kept
+                                            # sessions node-local, defect D7 —
+                                            # every failover invalidated all
+                                            # logins)
+
+Apply functions are deterministic and idempotent-friendly: every node
+applies the same committed command sequence and converges. No IO here —
+blob/file side effects live in lms.blobs, persistence in lms.persistence.
+
+Command set (SURVEY.md §2.4) plus Login/Logout/SetVal:
+    Register, Login, Logout, PostAssignment, GradeAssignment,
+    PostCourseMaterial, AskQuery, RespondToQuery, SetVal
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+from typing import Any, Dict, List, Optional
+
+
+def empty_state() -> Dict[str, Any]:
+    return {
+        "users": {},
+        "assignments": {},
+        "course_materials": [],
+        "queries": {},
+        "sessions": {},
+        "kv": {},
+    }
+
+
+def hash_password(password: str) -> str:
+    """At-rest hashing (reference stores plaintext; cheap improvement).
+    Deterministic (no salt) so appliers stay replicated-deterministic."""
+    return hashlib.sha256(("lms:" + password).encode()).hexdigest()
+
+
+class LMSState:
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self.data = data if data is not None else empty_state()
+        for key, default in empty_state().items():
+            self.data.setdefault(key, copy.deepcopy(default))
+
+    # ------------------------------------------------------------- appliers
+
+    def apply(self, op: str, args: Dict[str, Any]) -> None:
+        handler = getattr(self, f"_apply_{op.lower()}", None)
+        if handler is None:
+            raise ValueError(f"unknown LMS command {op!r}")
+        handler(args)
+
+    def _apply_register(self, a: Dict[str, Any]) -> None:
+        users = self.data["users"]
+        if a["username"] not in users:
+            users[a["username"]] = {
+                "password": a["password_hash"],
+                "role": a["role"],
+            }
+
+    def _apply_login(self, a: Dict[str, Any]) -> None:
+        self.data["sessions"][a["token"]] = a["username"]
+
+    def _apply_logout(self, a: Dict[str, Any]) -> None:
+        self.data["sessions"].pop(a["token"], None)
+
+    def _apply_postassignment(self, a: Dict[str, Any]) -> None:
+        lst = self.data["assignments"].setdefault(a["student"], [])
+        lst.append(
+            {
+                "filename": a["filename"],
+                "filepath": a["filepath"],
+                "grade": None,
+                "text": a["text"],
+            }
+        )
+
+    def _apply_gradeassignment(self, a: Dict[str, Any]) -> None:
+        # Reference semantics: the grade applies to all of the student's
+        # assignments (lms_server.py:1350-1353).
+        for assignment in self.data["assignments"].get(a["student"], []):
+            assignment["grade"] = a["grade"]
+
+    def _apply_postcoursematerial(self, a: Dict[str, Any]) -> None:
+        self.data["course_materials"].append(
+            {
+                "filename": a["filename"],
+                "filepath": a["filepath"],
+                "instructor": a["instructor"],
+            }
+        )
+
+    def _apply_askquery(self, a: Dict[str, Any]) -> None:
+        lst = self.data["queries"].setdefault(a["username"], [])
+        lst.append({"query": a["query"], "answered": False, "response": None})
+
+    def _apply_respondtoquery(self, a: Dict[str, Any]) -> None:
+        # Answers the student's oldest unanswered query (reference
+        # lms_server.py:1431-1448).
+        for query in self.data["queries"].get(a["student"], []):
+            if not query["answered"]:
+                query["response"] = a["response"]
+                query["answered"] = True
+                return
+
+    def _apply_setval(self, a: Dict[str, Any]) -> None:
+        self.data["kv"][a["key"]] = a["value"]
+
+    def _apply_noop(self, a: Dict[str, Any]) -> None:
+        pass
+
+    # --------------------------------------------------------------- reads
+
+    def user_of_token(self, token: str) -> Optional[str]:
+        return self.data["sessions"].get(token)
+
+    def role_of(self, username: str) -> Optional[str]:
+        user = self.data["users"].get(username)
+        return user["role"] if user else None
+
+    def check_password(self, username: str, password: str) -> bool:
+        user = self.data["users"].get(username)
+        return bool(user) and user["password"] == hash_password(password)
+
+    def assignments_of(self, student: str) -> List[Dict[str, Any]]:
+        return self.data["assignments"].get(student, [])
+
+    def unanswered_queries(self) -> List[Dict[str, str]]:
+        out = []
+        for student, queries in self.data["queries"].items():
+            for q in queries:
+                if not q["answered"]:
+                    out.append({"student": student, "query": q["query"]})
+        return out
+
+    def answered_queries_of(self, student: str) -> List[Dict[str, str]]:
+        return [
+            {"query": q["query"], "response": q["response"]}
+            for q in self.data["queries"].get(student, [])
+            if q["answered"]
+        ]

@@ -70,6 +70,7 @@ class RaftCore:
         *,
         now: float = 0.0,
         seed: Optional[int] = None,
+        last_applied: int = 0,
     ):
         self.node_id = node_id
         self.peer_ids = [p for p in peer_ids if p != node_id]
@@ -83,8 +84,12 @@ class RaftCore:
         # Volatile state.
         self.role = Role.FOLLOWER
         self.leader_id: Optional[int] = None
-        self.commit_index = 0
-        self.last_applied = 0
+        # A state-machine snapshot may cover a prefix of the log; start
+        # commit/applied there so replay resumes after it (lms.persistence
+        # stores applied_index in its snapshot).
+        last_applied = min(last_applied, len(self.log))
+        self.commit_index = last_applied
+        self.last_applied = last_applied
         self.votes: Set[int] = set()
         self.next_index: Dict[int, int] = {}
         self.match_index: Dict[int, int] = {}

@@ -61,9 +61,11 @@ class RaftNode:
         *,
         tick_interval: float = 0.01,
         seed: Optional[int] = None,
+        last_applied: int = 0,
     ):
         self.core = RaftCore(
-            node_id, peer_ids, storage, config, now=time.monotonic(), seed=seed
+            node_id, peer_ids, storage, config, now=time.monotonic(), seed=seed,
+            last_applied=last_applied,
         )
         self.transport = transport
         self.apply_cb = apply_cb

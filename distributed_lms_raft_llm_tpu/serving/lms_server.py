@@ -1,0 +1,153 @@
+"""LMS cluster server: Raft + LMS + FileTransfer on one gRPC endpoint.
+
+The TPU-era replacement for the reference's `python lms_server.py <id>
+<port> <peers...>` node (reference: GUI_RAFT_LLM_SourceCode/
+lms_server.py:1561-1613): same three servicers on one port, same positional
+CLI, but a single asyncio event loop instead of a thread pool + ticker
+thread, durable Raft state, commit-acked writes, and a long-lived BERT gate.
+
+Run (5-node cluster, reference topology):
+    python -m distributed_lms_raft_llm_tpu.serving.lms_server 1 50051 \
+        50051 50052 50053 50055 50056 --host 127.0.0.1
+
+Peers are listed as ports (same-host dev) or full host:port addresses,
+node ids 1..N in order. --tutoring points at the TPU tutoring node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Dict
+
+import grpc
+
+from ..lms.node import LMSNode
+from ..lms.service import FileTransferServicer, LMSServicer
+from ..proto import rpc
+from ..raft import RaftConfig
+from ..raft.grpc_transport import RaftServicer
+from ..utils.metrics import Metrics
+
+log = logging.getLogger("lms_server")
+
+
+def parse_addresses(peers, host: str) -> Dict[int, str]:
+    addresses = {}
+    for i, peer in enumerate(peers, start=1):
+        addresses[i] = peer if ":" in peer else f"{host}:{peer}"
+    return addresses
+
+
+async def serve_async(args) -> None:
+    addresses = parse_addresses(args.peers, args.host)
+    if args.id not in addresses:
+        raise SystemExit(f"node id {args.id} not in peer list")
+
+    raft_config = RaftConfig(
+        election_timeout_min=args.election_timeout / 2,
+        election_timeout_max=args.election_timeout,
+        heartbeat_interval=args.heartbeat_interval,
+    )
+    lms_node = LMSNode(
+        args.id, addresses, args.data_dir, raft_config=raft_config,
+        snapshot_every=args.snapshot_every,
+    )
+
+    gate = None
+    if args.gate_model:
+        from ..engine import GateConfig, RelevanceGate
+
+        gate = RelevanceGate(
+            GateConfig(model=args.gate_model, checkpoint=args.gate_checkpoint,
+                       vocab_path=args.gate_vocab)
+        )
+        gate.warmup()
+
+    metrics = Metrics()
+    servicer = LMSServicer(
+        lms_node.node,
+        lms_node.state,
+        lms_node.blobs,
+        gate=gate,
+        tutoring_address=args.tutoring,
+        metrics=metrics,
+    )
+    server = grpc.aio.server(
+        options=[
+            ("grpc.max_send_message_length", 50 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 50 * 1024 * 1024),
+        ]
+    )
+    rpc.add_LMSServicer_to_server(servicer, server)
+    rpc.add_RaftServiceServicer_to_server(
+        RaftServicer(lms_node.node, addresses, kv=lms_node.state.data["kv"]),
+        server,
+    )
+    rpc.add_FileTransferServiceServicer_to_server(
+        FileTransferServicer(lms_node.blobs), server
+    )
+    server.add_insecure_port(f"[::]:{args.port}")
+    await server.start()
+    await lms_node.start()
+    log.info("LMS node %d serving on %d (peers: %s)", args.id, args.port,
+             addresses)
+
+    async def report():
+        while True:
+            await asyncio.sleep(args.metrics_period)
+            log.info("metrics %s", json.dumps(metrics.snapshot()))
+
+    reporter = asyncio.get_running_loop().create_task(report())
+    try:
+        await server.wait_for_termination()
+    finally:
+        reporter.cancel()
+        await lms_node.stop()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("id", type=int, help="node id (1-based)")
+    parser.add_argument("port", type=int, help="port to serve on")
+    parser.add_argument("peers", nargs="+",
+                        help="cluster peer ports or host:port, ids 1..N")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--data-dir", default=None,
+                        help="state directory (default ./lms_node_<id>)")
+    parser.add_argument("--tutoring", default=None,
+                        help="tutoring server address (host:port)")
+    parser.add_argument("--gate-model", default=None,
+                        help="BERT gate model preset ('bert-base-uncased' or "
+                             "'tiny'); omit to disable the gate")
+    parser.add_argument("--gate-checkpoint", default=None)
+    parser.add_argument("--gate-vocab", default=None)
+    parser.add_argument("--election-timeout", type=float, default=0.5)
+    parser.add_argument("--heartbeat-interval", type=float, default=0.1)
+    parser.add_argument("--metrics-period", type=float, default=60.0)
+    parser.add_argument("--snapshot-every", type=int, default=64,
+                        help="full-state snapshot cadence in applied commands")
+    parser.add_argument(
+        "--jax-platform", default="cpu", choices=["cpu", "default"],
+        help="device for the in-process BERT gate; 'cpu' (default) keeps "
+             "control-plane nodes off the TPU so the tutoring node owns it",
+    )
+    args = parser.parse_args(argv)
+    if args.data_dir is None:
+        args.data_dir = f"lms_node_{args.id}"
+    if args.jax_platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    asyncio.run(serve_async(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -114,7 +114,15 @@ def main(argv=None) -> None:
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--max-wait-ms", type=float, default=10.0)
     parser.add_argument("--no-warmup", action="store_true")
+    parser.add_argument(
+        "--jax-platform", default="default", choices=["cpu", "default"],
+        help="'cpu' for CPU-only runs (tests/dev); default uses the TPU",
+    )
     args = parser.parse_args(argv)
+    if args.jax_platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     logging.basicConfig(
         level=logging.INFO,

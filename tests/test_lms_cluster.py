@@ -1,0 +1,243 @@
+"""Full-system integration: 3-node LMS cluster + TPU tutoring node + gate,
+driven through the sync client library over real gRPC — the end-to-end
+journey the reference validated manually (SURVEY.md §4)."""
+
+import asyncio
+import threading
+
+import pytest
+
+import jax
+
+from distributed_lms_raft_llm_tpu.client import LMSClient
+from distributed_lms_raft_llm_tpu.engine import (
+    BatchingQueue,
+    EngineConfig,
+    GateConfig,
+    RelevanceGate,
+    SamplingParams,
+    TutoringEngine,
+)
+from distributed_lms_raft_llm_tpu.lms.node import LMSNode
+from distributed_lms_raft_llm_tpu.lms.service import (
+    FileTransferServicer,
+    LMSServicer,
+)
+from distributed_lms_raft_llm_tpu.proto import rpc
+from distributed_lms_raft_llm_tpu.raft import RaftConfig
+from distributed_lms_raft_llm_tpu.raft.grpc_transport import RaftServicer
+from distributed_lms_raft_llm_tpu.serving import tutoring_server as ts
+from distributed_lms_raft_llm_tpu.utils import pdf
+from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+import grpc
+
+FAST = RaftConfig(
+    election_timeout_min=0.11, election_timeout_max=0.22, heartbeat_interval=0.05
+)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """3 LMS nodes + tutoring server on a private event-loop thread."""
+    tmp = tmp_path_factory.mktemp("cluster")
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            # Tutoring node (tiny model).
+            engine = TutoringEngine(
+                EngineConfig(
+                    model="tiny",
+                    sampling=SamplingParams(max_new_tokens=6),
+                    length_buckets=(32,),
+                    batch_buckets=(1, 2, 4),
+                    dtype=jax.numpy.float32,
+                )
+            )
+            queue = BatchingQueue(engine, max_batch=4, max_wait_ms=10)
+            await queue.start()
+            tut_server = grpc.aio.server()
+            rpc.add_TutoringServicer_to_server(
+                ts.TutoringService(queue, Metrics()), tut_server
+            )
+            tut_port = tut_server.add_insecure_port("127.0.0.1:0")
+            await tut_server.start()
+
+            gate = RelevanceGate(
+                GateConfig(model="tiny", dtype=jax.numpy.float32, threshold=0.0)
+            )
+
+            ids = [1, 2, 3]
+            servers, addresses = {}, {}
+            for i in ids:
+                servers[i] = grpc.aio.server(
+                    options=[("grpc.max_receive_message_length", 50 * 1024 * 1024)]
+                )
+                port = servers[i].add_insecure_port("127.0.0.1:0")
+                addresses[i] = f"127.0.0.1:{port}"
+            lms_nodes = {}
+            for i in ids:
+                node = LMSNode(
+                    i, addresses, str(tmp / f"node{i}"), raft_config=FAST
+                )
+                servicer = LMSServicer(
+                    node.node, node.state, node.blobs, gate=gate,
+                    tutoring_address=f"127.0.0.1:{tut_port}",
+                )
+                rpc.add_LMSServicer_to_server(servicer, servers[i])
+                rpc.add_RaftServiceServicer_to_server(
+                    RaftServicer(node.node, addresses,
+                                 kv=node.state.data["kv"]),
+                    servers[i],
+                )
+                rpc.add_FileTransferServiceServicer_to_server(
+                    FileTransferServicer(node.blobs), servers[i]
+                )
+                await servers[i].start()
+                await node.start()
+                lms_nodes[i] = node
+            state.update(
+                servers=servers, nodes=lms_nodes, addresses=addresses,
+                tut_server=tut_server, queue=queue, tmp=tmp, loop=loop,
+            )
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(60)
+    yield state
+
+    async def teardown():
+        for node in state["nodes"].values():
+            if not node.node._stopped:
+                await node.stop()
+        for s in state["servers"].values():
+            await s.stop(None)
+        await state["queue"].close()
+        await state["tut_server"].stop(None)
+
+    asyncio.run_coroutine_threadsafe(teardown(), loop).result(30)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    c = LMSClient(list(cluster["addresses"].values()),
+                  discovery_backoff_s=0.2)
+    yield c
+    c.close()
+
+
+def test_full_student_instructor_journey(client):
+    # -- registration / login ------------------------------------------------
+    assert client.register("ana", "pw1", "student").success
+    assert client.register("prof", "pw2", "instructor").success
+    assert not client.register("ana", "zzz", "student").success  # duplicate
+    assert client.login("prof", "pw2") and client.role == "instructor"
+
+    # -- instructor posts course material ------------------------------------
+    material = pdf.make_pdf("Lecture 4: B-trees, LSM trees, and storage engines")
+    assert client.upload_course_material("lecture4.pdf", material)
+    client.logout()
+
+    # -- student journey -----------------------------------------------------
+    assert client.login("ana", "pw1") and client.role == "student"
+    mats = client.course_materials()
+    assert [m.filename for m in mats] == ["lecture4.pdf"]
+    assert mats[0].file == material  # bytes round-trip through the blob store
+
+    hw = pdf.make_pdf("Homework: implement a B-tree with insert and split")
+    assert client.upload_assignment("hw1.pdf", hw)
+    assert "No grade" in client.my_grade()
+
+    # LLM path: gate (threshold 0 in fixture) + tutoring node
+    resp = client.ask_llm("How does a B-tree split work?")
+    assert resp.success
+
+    assert client.ask_instructor("When is hw1 due?")
+    client.logout()
+
+    # -- instructor grades + responds ----------------------------------------
+    assert client.login("prof", "pw2")
+    subs = client.student_assignments()
+    assert [(e.id, e.filename) for e in subs] == [("ana", "hw1.pdf")]
+    assert subs[0].file == hw
+    assert client.grade("ana", "A").success
+    queries = client.unanswered_queries()
+    assert [(q.id, q.data) for q in queries] == [("ana", "When is hw1 due?")]
+    assert client.respond_to_query("ana", "Friday midnight.")
+    client.logout()
+
+    # -- student sees results ------------------------------------------------
+    assert client.login("ana", "pw1")
+    assert client.my_grade() == "Your grade: A"
+    responses = client.instructor_responses()
+    assert len(responses) == 1
+    assert "Friday midnight." in responses[0].data
+    client.logout()
+
+
+def test_unauthorized_paths(client):
+    assert client.login("ana", "pw1")
+    # Student cannot grade or list assignments.
+    assert not client.grade("ana", "F").success
+    assert client.student_assignments() == []
+    client.logout()
+    # Bogus token fails cleanly.
+    client.token = "forged-token"
+    assert client.my_grade() in ("Invalid session",)
+    client.token = None
+
+
+def test_state_replicated_to_all_nodes(cluster, client):
+    """After the journey, every node's state machine has converged."""
+    import time
+
+    time.sleep(0.5)  # let followers apply the tail
+    datas = [n.state.data for n in cluster["nodes"].values()]
+    for d in datas:
+        assert set(d["users"]) == {"ana", "prof"}
+        assert [a["grade"] for a in d["assignments"]["ana"]] == ["A"]
+        assert d["queries"]["ana"][0]["answered"]
+
+
+def test_uploaded_files_replicated_to_followers(cluster, client):
+    import time
+
+    time.sleep(0.5)
+    present = [
+        n.blobs.exists("materials/lecture4.pdf")
+        and n.blobs.exists("assignments/ana/hw1.pdf")
+        for n in cluster["nodes"].values()
+    ]
+    assert all(present), present
+
+
+def test_sessions_survive_failover(cluster, client):
+    """The D7 fix: a login taken before leader failure works after it."""
+
+    async def stop_leader():
+        for node in cluster["nodes"].values():
+            if node.node.is_leader:
+                await node.stop()
+                return node.node_id
+        return None
+
+    assert client.login("ana", "pw1")
+    token_before = client.token
+    # Stop the current leader from the cluster's own loop.
+    fut = asyncio.run_coroutine_threadsafe(stop_leader(), cluster["loop"])
+    stopped = fut.result(10)
+    assert stopped is not None
+    client.discover_leader(force=True)
+    # Old token still valid on the new leader (sessions are replicated).
+    assert client.my_grade() == "Your grade: A"
+    assert client.token == token_before

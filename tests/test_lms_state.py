@@ -1,0 +1,117 @@
+"""LMS state machine + persistence + PDF unit tests."""
+
+from distributed_lms_raft_llm_tpu.lms import (
+    BlobStore,
+    LMSState,
+    SnapshotStore,
+    hash_password,
+)
+from distributed_lms_raft_llm_tpu.utils import pdf
+
+import pytest
+
+
+def test_register_login_logout_flow():
+    s = LMSState()
+    s.apply("Register", {"username": "ana", "password_hash": hash_password("pw"),
+                         "role": "student"})
+    assert s.check_password("ana", "pw")
+    assert not s.check_password("ana", "wrong")
+    s.apply("Login", {"username": "ana", "token": "tok1"})
+    assert s.user_of_token("tok1") == "ana"
+    s.apply("Logout", {"token": "tok1"})
+    assert s.user_of_token("tok1") is None
+
+
+def test_register_is_first_writer_wins():
+    s = LMSState()
+    s.apply("Register", {"username": "bo", "password_hash": "h1", "role": "student"})
+    s.apply("Register", {"username": "bo", "password_hash": "h2", "role": "instructor"})
+    assert s.data["users"]["bo"]["password"] == "h1"
+    assert s.role_of("bo") == "student"
+
+
+def test_assignment_grade_query_lifecycle():
+    s = LMSState()
+    s.apply("Register", {"username": "st", "password_hash": "h", "role": "student"})
+    s.apply("PostAssignment", {"student": "st", "filename": "hw1.pdf",
+                               "filepath": "assignments/st/hw1.pdf",
+                               "text": "trees"})
+    s.apply("PostAssignment", {"student": "st", "filename": "hw2.pdf",
+                               "filepath": "assignments/st/hw2.pdf",
+                               "text": "graphs"})
+    assert [a["grade"] for a in s.assignments_of("st")] == [None, None]
+    # Reference semantics: grade applies to all the student's assignments.
+    s.apply("GradeAssignment", {"student": "st", "grade": "A"})
+    assert [a["grade"] for a in s.assignments_of("st")] == ["A", "A"]
+
+    s.apply("AskQuery", {"username": "st", "query": "what is a B-tree?"})
+    s.apply("AskQuery", {"username": "st", "query": "and an LSM?"})
+    assert len(s.unanswered_queries()) == 2
+    # Responds to the oldest unanswered query first.
+    s.apply("RespondToQuery", {"instructor": "in", "student": "st",
+                               "response": "a balanced tree"})
+    unanswered = s.unanswered_queries()
+    assert len(unanswered) == 1 and unanswered[0]["query"] == "and an LSM?"
+    answered = s.answered_queries_of("st")
+    assert answered == [{"query": "what is a B-tree?",
+                         "response": "a balanced tree"}]
+
+
+def test_unknown_op_raises():
+    with pytest.raises(ValueError):
+        LMSState().apply("DropTables", {})
+
+
+def test_snapshot_roundtrip(tmp_path):
+    store = SnapshotStore(str(tmp_path / "snap.json"))
+    s = LMSState()
+    s.apply("Register", {"username": "u", "password_hash": "h", "role": "student"})
+    store.save(s, applied_index=7)
+    s2, idx = store.load()
+    assert idx == 7
+    assert "u" in s2.data["users"]
+
+
+def test_snapshot_missing_and_corrupt(tmp_path):
+    store = SnapshotStore(str(tmp_path / "none.json"))
+    s, idx = store.load()
+    assert idx == 0 and s.data["users"] == {}
+    (tmp_path / "bad.json").write_text("{not json")
+    store2 = SnapshotStore(str(tmp_path / "bad.json"))
+    s, idx = store2.load()
+    assert idx == 0
+
+
+def test_blob_store_confines_paths(tmp_path):
+    blobs = BlobStore(str(tmp_path / "uploads"))
+    blobs.put("materials/a.pdf", b"data")
+    assert blobs.get("materials/a.pdf") == b"data"
+    with pytest.raises(ValueError):
+        blobs.put("../escape.pdf", b"x")
+    with pytest.raises(ValueError):
+        blobs.get("../../etc/passwd")
+
+
+def test_blob_writer_replaces_not_appends(tmp_path):
+    blobs = BlobStore(str(tmp_path / "uploads"))
+    for _ in range(2):  # resend the same file (reference D5 duplicated it)
+        w = blobs.open_writer("materials/m.pdf")
+        w.write(b"12345")
+        w.write(b"67890")
+        w.commit()
+    assert blobs.get("materials/m.pdf") == b"1234567890"
+
+
+def test_pdf_roundtrip_multiline():
+    data = pdf.make_pdf("line one\nline two (with parens)")
+    text = pdf.extract_text(data)
+    assert "line one" in text and "with parens" in text
+    assert pdf.extract_text(b"not a pdf") == ""
+
+
+def test_pdf_escaped_backslash_sequences():
+    # A backslash followed by n/t must survive the escape decoder.
+    for text in ["C:\\new\\table", "a\\b", "octal \x01 ok"]:
+        data = pdf.make_pdf(text)
+        assert pdf.extract_text(data) == text.replace("\x01", "\x01")
