@@ -1,0 +1,11 @@
+"""Training path: LM loss, sharded optimizer step, train state."""
+
+from .train import (  # noqa: F401
+    TrainConfig,
+    init_train_state,
+    lm_loss,
+    make_optimizer,
+    make_sharded_train_step,
+    make_train_step,
+    train_state_shardings,
+)

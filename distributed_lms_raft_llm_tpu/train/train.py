@@ -1,0 +1,171 @@
+"""Sharded training step: LM loss + optax optimizer under pjit.
+
+The reference has no training at all (SURVEY.md §2.2) — models come frozen
+from the HF hub. A TPU-native framework needs the training path anyway
+(fine-tuning the tutoring model on course data is the obvious extension),
+and the multi-chip dry-run validates it: parameters/optimizer state shard
+per `parallel.partition` rules (tp), the batch shards over dp, gradients
+reduce across dp implicitly via jit's sharding propagation, and activations
+can be rematerialized (`jax.checkpoint`) to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import gpt2
+from ..parallel import partition
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+    warmup_steps: int = 100
+    max_grad_norm: float = 1.0
+    remat: bool = True  # rematerialize block activations (HBM for FLOPs)
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=cfg.learning_rate,
+        warmup_steps=cfg.warmup_steps,
+        decay_steps=10_000,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adamw(schedule, weight_decay=cfg.weight_decay),
+    )
+
+
+def lm_loss(
+    logits: jax.Array, targets: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Token-mean cross entropy; logits [B,T,V] f32, targets/mask [B,T]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = mask.astype(jnp.float32)
+    return -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def init_train_state(
+    rng: jax.Array, model_cfg: gpt2.GPT2Config, optimizer
+) -> Dict[str, Any]:
+    params = gpt2.init_params(rng, model_cfg)
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def train_state_shardings(state, mesh: Mesh):
+    """NamedShardings for the whole train state: params + optimizer moments
+    follow the model partition rules (adam mu/nu mirror param shapes);
+    scalars replicate."""
+
+    param_specs = partition.match_partition_rules(
+        partition.GPT2_RULES, state["params"]
+    )
+
+    # Optimizer leaves that mirror a parameter (same shape) reuse its spec;
+    # everything else (counts, scalars) replicates.
+    flat_params, _ = jax.tree_util.tree_flatten(state["params"])
+    flat_specs, _ = jax.tree_util.tree_flatten(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    shape_to_spec = {}
+    for leaf, spec in zip(flat_params, flat_specs):
+        shape_to_spec.setdefault(leaf.shape, spec)
+
+    def leaf_spec(leaf):
+        if getattr(leaf, "ndim", 0) == 0:
+            return P()
+        return shape_to_spec.get(leaf.shape, P())
+
+    specs = {
+        "params": param_specs,
+        "opt_state": jax.tree.map(leaf_spec, state["opt_state"]),
+        "step": P(),
+    }
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def make_train_step(
+    model_cfg: gpt2.GPT2Config,
+    optimizer,
+    remat: bool = True,
+) -> Callable:
+    """Returns train_step(state, batch) -> (state, metrics); jit it with the
+    shardings from `train_state_shardings` + batch over dp."""
+
+    forward = gpt2.forward
+    if remat:
+        forward = jax.checkpoint(
+            partial(gpt2.forward), static_argnums=(1,)
+        )
+
+    def loss_fn(params, input_ids, loss_mask):
+        logits, _ = forward(params, model_cfg, input_ids)
+        # next-token prediction: shift by one
+        loss = lm_loss(logits[:, :-1], input_ids[:, 1:], loss_mask[:, 1:])
+        return loss
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], batch["input_ids"], batch["loss_mask"]
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        gnorm = optax.global_norm(grads)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    return train_step
+
+
+def make_sharded_train_step(
+    mesh: Mesh, model_cfg: gpt2.GPT2Config, train_cfg: TrainConfig, rng
+):
+    """Everything wired: returns (jitted_step, sharded_state, batch_sharding).
+
+    The batch shards over dp; XLA derives the gradient all-reduce over dp
+    and the tensor-parallel collectives over tp from the argument shardings
+    alone — no hand-written collectives (SURVEY.md §2.2 TPU-native plan).
+    """
+    optimizer = make_optimizer(train_cfg)
+    with jax.default_device(jax.devices()[0]):
+        state = init_train_state(rng, model_cfg, optimizer)
+    state_shardings = train_state_shardings(state, mesh)
+    state = jax.tree.map(
+        lambda x, s: jax.device_put(x, s), state, state_shardings
+    )
+    batch_sharding = {
+        "input_ids": NamedSharding(mesh, P("dp", None)),
+        "loss_mask": NamedSharding(mesh, P("dp", None)),
+    }
+    step = jax.jit(
+        make_train_step(model_cfg, optimizer, remat=train_cfg.remat),
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+    return step, state, batch_sharding
