@@ -16,6 +16,8 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -24,7 +26,26 @@ import numpy as np
 BATCH = 8
 PROMPT_LEN = 48
 MAX_NEW = 128
-ROUNDS = 5
+ROUNDS = 10
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LOCAL_CKPT_DIR = os.path.join(REPO, "data", "gpt2-local")
+
+
+def ensure_local_artifacts() -> dict:
+    """Checkpoint + vocab for the real-weights path (built locally: the
+    image has no network and no HF cache — see scripts/make_local_checkpoint
+    for why this is the strongest obtainable artifact)."""
+    ckpt = os.path.join(LOCAL_CKPT_DIR, "model.safetensors")
+    vocab = os.path.join(LOCAL_CKPT_DIR, "vocab.json")
+    merges = os.path.join(LOCAL_CKPT_DIR, "merges.txt")
+    if not all(os.path.exists(p) for p in (ckpt, vocab, merges)):
+        subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "make_local_checkpoint.py")],
+            check=True, timeout=900, cwd=REPO,
+        )
+    return {"checkpoint": ckpt, "vocab_path": vocab, "merges_path": merges}
 
 # Fallback when torch isn't importable at bench time: torch-CPU GPT-2-small
 # single-stream generate measured on this image (tokens/sec).
@@ -47,38 +68,42 @@ def bench_tpu() -> dict:
             sampling=SamplingParams.reference_defaults(max_new_tokens=MAX_NEW),
             length_buckets=(PROMPT_LEN, 64, 128),
             batch_buckets=(1, 2, 4, 8),
+            **ensure_local_artifacts(),
         )
     )
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, 50000, (BATCH, PROMPT_LEN)).astype(np.int32)
+    ids = rng.integers(0, engine.tokenizer.vocab_size,
+                       (BATCH, PROMPT_LEN)).astype(np.int32)
     mask = np.ones((BATCH, PROMPT_LEN), bool)
 
     compile_t0 = time.monotonic()
     engine.generate_ids(ids, mask)  # compile + warm
     compile_s = time.monotonic() - compile_t0
 
-    total_tokens = 0
+    # Throughput under sustained load: dispatch rounds back-to-back (as a
+    # loaded server pipelines batches) and sync once at the end, so the
+    # host↔device round-trip latency overlaps compute instead of
+    # serializing every batch.
     t0 = time.monotonic()
-    for _ in range(ROUNDS):
-        result = engine.generate_ids(ids, mask)
-        total_tokens += int(np.sum(result.lengths))
+    results = [
+        engine.generate_ids(ids, mask, measure_ttft=False, device_result=True)
+        for _ in range(ROUNDS)
+    ]
+    results = jax.device_get(results)
     elapsed = time.monotonic() - t0
+    total_tokens = sum(int(np.sum(r.lengths)) for r in results)
     tps = total_tokens / elapsed
 
-    # TTFT proxy: single-query prefill+first-token latency, warm program.
+    # TTFT, measured: the engine blocks on the first sampled token between
+    # its prefill and decode programs and records the wall-clock in
+    # last_ttft_s (transfer + prefill + first sample + readback).
     one_ids, one_mask = ids[:1], mask[:1]
     engine.generate_ids(one_ids, one_mask)  # compile batch-1 program
     lat = []
-    for _ in range(5):
-        t = time.monotonic()
+    for _ in range(7):
         engine.generate_ids(one_ids, one_mask)
-        lat.append(time.monotonic() - t)
-    # One generate call emits MAX_NEW tokens; prefill+1 token ≈ lat/MAX_NEW
-    # is unfair to us, so report full-answer latency scaled to first token
-    # via per-token decode time.
-    full = sorted(lat)[len(lat) // 2]
-    per_token = full / MAX_NEW
-    ttft_ms = (full - per_token * (MAX_NEW - 1)) * 1000.0
+        lat.append(engine.last_ttft_s)
+    ttft_ms = sorted(lat)[len(lat) // 2] * 1000.0
 
     return {
         "tokens_per_sec_per_chip": tps / n_chips,

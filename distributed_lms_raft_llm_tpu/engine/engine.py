@@ -33,7 +33,8 @@ from ..models import convert, gpt2
 from ..parallel import mesh as mesh_lib
 from ..parallel import partition
 from ..utils import tokenizer as tok_lib
-from .generate import GenerateResult, generate, pick_bucket
+from ..utils.compilation import enable_compilation_cache
+from .generate import GenerateResult, decode, pick_bucket, prefill
 from .sampling import SamplingParams
 
 log = logging.getLogger(__name__)
@@ -52,10 +53,14 @@ class EngineConfig:
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     tp: int = 1  # tensor-parallel ways; dp absorbs remaining devices
     dtype: Any = jnp.bfloat16
+    # Serving stores weights in bf16: halves the HBM read per decode step
+    # versus f32 (the decode loop is memory-bound — every step streams all
+    # parameters from HBM). Golden tests override to f32 for bit-accuracy.
+    param_dtype: Any = jnp.bfloat16
     seed: int = 0
 
     @staticmethod
-    def model_config(name: str, dtype) -> gpt2.GPT2Config:
+    def model_config(name: str, dtype, param_dtype=None) -> gpt2.GPT2Config:
         presets = {
             "gpt2": gpt2.GPT2Config.small,
             "gpt2-medium": gpt2.GPT2Config.medium,
@@ -65,13 +70,16 @@ class EngineConfig:
         }
         if name not in presets:
             raise ValueError(f"unknown model preset {name!r}")
-        return presets[name](dtype=dtype)
+        return presets[name](dtype=dtype, param_dtype=param_dtype or dtype)
 
 
 class TutoringEngine:
     def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None):
+        enable_compilation_cache()
         self.config = config
-        self.cfg = EngineConfig.model_config(config.model, config.dtype)
+        self.cfg = EngineConfig.model_config(
+            config.model, config.dtype, config.param_dtype
+        )
         self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1}, devices=devices)
         self.tokenizer = tok_lib.load_gpt2_tokenizer(
             config.vocab_path, config.merges_path
@@ -103,17 +111,20 @@ class TutoringEngine:
         log.info("params ready in %.1fs (mesh %s)", time.monotonic() - t0,
                  dict(zip(self.mesh.axis_names, self.mesh.devices.shape)))
 
-        # One jitted wrapper; jit itself specializes/caches per input shape
-        # (one compiled program per (batch bucket, length bucket)).
-        self._generate = jax.jit(
-            partial(
-                generate,
-                cfg=self.cfg,
-                sampling=self.config.sampling,
-                eos_id=self.tokenizer.eos_id,
-                pad_id=self.tokenizer.pad_id,
-            )
+        # Two jitted programs per input shape (prefill, decode): the engine
+        # blocks on prefill's first token — the honest TTFT boundary — then
+        # dispatches decode, donating the state so the KV cache buffers are
+        # reused in place across the handoff. jit itself specializes/caches
+        # per (batch bucket, length bucket).
+        statics = dict(
+            cfg=self.cfg,
+            sampling=self.config.sampling,
+            eos_id=self.tokenizer.eos_id,
+            pad_id=self.tokenizer.pad_id,
         )
+        self._prefill = jax.jit(partial(prefill, **statics))
+        self._decode = jax.jit(partial(decode, **statics), donate_argnums=(1,))
+        self.last_ttft_s: Optional[float] = None
 
     def _max_prompt_len(self) -> int:
         return min(
@@ -162,12 +173,34 @@ class TutoringEngine:
         self.generate_ids(ids, mask)
         return time.monotonic() - t0
 
-    def generate_ids(self, ids: np.ndarray, mask: np.ndarray) -> GenerateResult:
+    def generate_ids(
+        self,
+        ids: np.ndarray,
+        mask: np.ndarray,
+        measure_ttft: bool = True,
+        device_result: bool = False,
+    ) -> GenerateResult:
+        """Generate for a pre-bucketed id batch; records measured TTFT.
+
+        `self.last_ttft_s` is the wall-clock from dispatch to the first
+        sampled token being on the host — an actual measurement (host→device
+        transfer + prefill + first sample + device→host), not an estimate.
+
+        measure_ttft=False skips that blocking readback and device_result=True
+        returns device arrays without fetching: back-to-back calls then
+        pipeline (dispatch N+1 while N computes), which is how a loaded
+        server runs and how throughput should be measured.
+        """
         self._rng, rng = jax.random.split(self._rng)
+        t0 = time.monotonic()
         with self.mesh:
-            result = self._generate(self.params, input_ids=jnp.asarray(ids),
-                                    prompt_mask=jnp.asarray(mask), rng=rng)
-        return jax.device_get(result)
+            state = self._prefill(self.params, input_ids=jnp.asarray(ids),
+                                  prompt_mask=jnp.asarray(mask), rng=rng)
+            if measure_ttft:
+                np.asarray(state.out[:, 0])  # blocks until the first token exists
+                self.last_ttft_s = time.monotonic() - t0
+            result = self._decode(self.params, state)
+        return result if device_result else jax.device_get(result)
 
     def answer_batch(self, prompts: Sequence[str]) -> List[str]:
         """The serving entry: prompts in, decoded answers out.

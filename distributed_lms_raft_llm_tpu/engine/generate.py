@@ -6,6 +6,15 @@ prompt batch prefills in one static-shape pass, then a `lax.while_loop`
 decodes with a KV cache, sampling fused into the step — no host round-trips
 per token. Early exit when every row has emitted EOS.
 
+Generation is split into two jittable halves so the serving layer can
+measure time-to-first-token for real instead of deriving it:
+
+- `prefill` runs the prompt pass and samples the FIRST token; the engine
+  blocks on that token, which is the honest TTFT boundary;
+- `decode` continues from the returned `DecodeState` under a while_loop.
+  The state (KV cache included) is donated by the engine's jit wrapper, so
+  the handoff between the two programs reuses the cache buffers in place.
+
 Shapes are static: prompts are left-padded to a bucket length; the cache is
 sized exactly `bucket + max_new_tokens` so the precondition documented in
 models/gpt2.py (no silent cache overflow) holds by construction.
@@ -31,12 +40,27 @@ class GenerateResult(NamedTuple):
     lengths: jax.Array  # [B] int32 — emitted tokens per row (including EOS)
 
 
+class DecodeState(NamedTuple):
+    """Carry between the prefill and decode programs (and loop iterations)."""
+
+    cache: gpt2.KVCache
+    tok: jax.Array        # [B] last sampled token
+    rng: jax.Array
+    out: jax.Array        # [B, max_new]
+    seen: jax.Array       # [B, V]
+    done: jax.Array       # [B]
+    lengths: jax.Array    # [B]
+    step: jax.Array       # []
+    real_lens: jax.Array  # [B] true prompt lengths (positions base)
+    kv_mask: jax.Array    # [B, cache_len] key-slot validity
+
+
 def make_positions(prompt_mask: jax.Array) -> jax.Array:
     """Per-row position ids for a left-padded prompt ([B, T] bool -> int32)."""
     return jnp.maximum(jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0)
 
 
-def generate(
+def prefill(
     params,
     cfg: gpt2.GPT2Config,
     input_ids: jax.Array,
@@ -45,11 +69,13 @@ def generate(
     sampling: SamplingParams,
     eos_id: int,
     pad_id: int,
-) -> GenerateResult:
-    """Sample continuations for a left-padded prompt batch.
+) -> DecodeState:
+    """Prompt pass + first sampled token; returns the state `decode` resumes.
 
     Pure and jittable: `cfg`, `sampling`, `eos_id`, `pad_id` are static.
     input_ids [B, T] int32, prompt_mask [B, T] bool (False = left padding).
+    The first token is `state.out[:, 0]` — the engine blocks on it to record
+    TTFT before dispatching `decode`.
     """
     b, t = input_ids.shape
     max_new = sampling.max_new_tokens
@@ -80,39 +106,43 @@ def generate(
     rng, step_rng = jax.random.split(rng)
     first_tok = sample_step(step_rng, last_logits, seen, sampling)
 
-    class State(NamedTuple):
-        cache: gpt2.KVCache
-        tok: jax.Array        # [B] last sampled token
-        rng: jax.Array
-        out: jax.Array        # [B, max_new]
-        seen: jax.Array       # [B, V]
-        done: jax.Array       # [B]
-        lengths: jax.Array    # [B]
-        step: jax.Array       # []
-
     out0 = jnp.full((b, max_new), pad_id, jnp.int32)
     out0 = out0.at[:, 0].set(first_tok)
-    done0 = first_tok == eos_id
-    state = State(
+    return DecodeState(
         cache=cache,
         tok=first_tok,
         rng=rng,
         out=out0,
         seen=update_seen(seen, first_tok),
-        done=done0,
+        done=first_tok == eos_id,
         lengths=jnp.ones((b,), jnp.int32),
         step=jnp.ones((), jnp.int32),
+        real_lens=real_lens,
+        kv_mask=kv_mask,
     )
 
-    def cond(s: State):
+
+def decode(
+    params,
+    state: DecodeState,
+    cfg: gpt2.GPT2Config,
+    sampling: SamplingParams,
+    eos_id: int,
+    pad_id: int,
+) -> GenerateResult:
+    """Run the while_loop decode from a prefilled state to completion."""
+    max_new = sampling.max_new_tokens
+
+    def cond(s: DecodeState):
         return (s.step < max_new) & ~jnp.all(s.done)
 
-    def body(s: State) -> State:
+    def body(s: DecodeState) -> DecodeState:
         # Feed last token; its slot is t + step - 1, its position is
         # real_lens + step - 1 (both per the left-padded layout).
-        pos = (real_lens + s.step - 1)[:, None]
+        pos = (s.real_lens + s.step - 1)[:, None]
         logits, cache = gpt2.forward(
-            params, cfg, s.tok[:, None], cache=s.cache, positions=pos, kv_mask=kv_mask
+            params, cfg, s.tok[:, None], cache=s.cache, positions=pos,
+            kv_mask=s.kv_mask,
         )
         rng, step_rng = jax.random.split(s.rng)
         nxt = sample_step(step_rng, logits[:, 0], s.seen, sampling)
@@ -120,7 +150,7 @@ def generate(
         out = jax.lax.dynamic_update_slice(s.out, nxt[:, None], (0, s.step))
         lengths = s.lengths + (~s.done).astype(jnp.int32)
         done = s.done | (nxt == eos_id)
-        return State(
+        return DecodeState(
             cache=cache,
             tok=nxt,
             rng=rng,
@@ -129,10 +159,33 @@ def generate(
             done=done,
             lengths=lengths,
             step=s.step + 1,
+            real_lens=s.real_lens,
+            kv_mask=s.kv_mask,
         )
 
     final = jax.lax.while_loop(cond, body, state)
     return GenerateResult(tokens=final.out, lengths=final.lengths)
+
+
+def generate(
+    params,
+    cfg: gpt2.GPT2Config,
+    input_ids: jax.Array,
+    prompt_mask: jax.Array,
+    rng: jax.Array,
+    sampling: SamplingParams,
+    eos_id: int,
+    pad_id: int,
+) -> GenerateResult:
+    """Sample continuations for a left-padded prompt batch (one program).
+
+    Composition of `prefill` + `decode` for callers that don't need the
+    TTFT split (tests, offline batch work).
+    """
+    state = prefill(
+        params, cfg, input_ids, prompt_mask, rng, sampling, eos_id, pad_id
+    )
+    return decode(params, state, cfg, sampling, eos_id, pad_id)
 
 
 def pick_bucket(length: int, buckets: Tuple[int, ...]) -> int:

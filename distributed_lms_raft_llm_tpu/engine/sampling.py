@@ -86,12 +86,30 @@ def sample_step(
     seen_mask: jax.Array,
     params: SamplingParams,
 ) -> jax.Array:
-    """One sampling step: [B, V] float32 logits -> [B] int32 token ids."""
+    """One sampling step: [B, V] float32 logits -> [B] int32 token ids.
+
+    When top_k is active it bounds the nucleus set, so the whole
+    top-p/temperature/sample pipeline runs on the k retained values — one
+    `lax.top_k` over the vocab instead of three full-vocab sorts. This is
+    the decode hot path: k is 50, the vocab is 50,257.
+    """
     logits = apply_repetition_penalty(logits, seen_mask, params.repetition_penalty)
     if params.temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / params.temperature
-    logits = apply_top_k(logits, params.top_k)
+    k = params.top_k
+    if 0 < k < logits.shape[-1]:
+        # top_k returns values sorted descending — exactly the order HF's
+        # nucleus filter cumsums in, so the two paths are equivalent.
+        top_vals, top_idx = jax.lax.top_k(logits, k)
+        if params.top_p < 1.0:
+            probs = jax.nn.softmax(top_vals, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            top_vals = jnp.where((cum - probs) > params.top_p, NEG_INF, top_vals)
+        choice = jax.random.categorical(rng, top_vals, axis=-1)
+        return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(
+            jnp.int32
+        )
     logits = apply_top_p(logits, params.top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 

@@ -158,6 +158,49 @@ def bert_params_from_hf(sd: StateDict, cfg: BertConfig) -> Dict[str, Any]:
     }
 
 
+def save_safetensors(path: str, tensors: Mapping[str, np.ndarray]) -> None:
+    """Write a .safetensors file (the exact inverse of `load_safetensors`).
+
+    Used by checkpoint export (training, HF-layout conversion) and by tests
+    that round-trip a torch `state_dict()` through the standard format.
+    bfloat16 inputs (e.g. jax arrays) are stored as BF16.
+    """
+    import json
+    import struct
+
+    name_for = {
+        np.dtype(np.float64): "F64", np.dtype(np.float32): "F32",
+        np.dtype(np.float16): "F16", np.dtype(np.int64): "I64",
+        np.dtype(np.int32): "I32", np.dtype(np.int16): "I16",
+        np.dtype(np.int8): "I8", np.dtype(np.uint8): "U8",
+        np.dtype(np.bool_): "BOOL",
+    }
+    header: Dict[str, Any] = {}
+    blobs = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = _np(arr)
+        if arr.dtype.name == "bfloat16":  # ml_dtypes bfloat16 from jax
+            raw = arr.view(np.uint16).tobytes()
+            dtype_name = "BF16"
+        else:
+            raw = np.ascontiguousarray(arr).tobytes()
+            dtype_name = name_for[arr.dtype]
+        header[name] = {
+            "dtype": dtype_name,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(raw)],
+        }
+        blobs.append(raw)
+        offset += len(raw)
+    head = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(head)))
+        f.write(head)
+        for raw in blobs:
+            f.write(raw)
+
+
 def load_safetensors(path: str) -> Dict[str, np.ndarray]:
     """Read a .safetensors file into numpy arrays (no torch).
 

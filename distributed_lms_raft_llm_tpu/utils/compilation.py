@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache setup.
+
+Cold-start compiles for the serving programs are tens of seconds (the
+round-1 bench paid 21.4 s per process). JAX can persist compiled
+executables keyed by HLO fingerprint; enabling it once per process makes
+every warm restart skip straight to execution. The reference has no
+analogue (PyTorch eager), so this is pure TPU-platform work.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_enabled = False
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> str:
+    """Idempotently point JAX at an on-disk compilation cache.
+
+    Resolution order: explicit `path` arg, `JAX_COMPILATION_CACHE_DIR`,
+    then `~/.cache/dlrl_tpu/xla_cache`.
+    """
+    global _enabled
+    import jax
+
+    path = (
+        path
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.expanduser("~/.cache/dlrl_tpu/xla_cache")
+    )
+    if _enabled:
+        return path
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache every program that took non-trivial compile time; the decode
+    # program is the one that matters and always clears this bar.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _enabled = True
+    log.info("XLA compilation cache at %s", path)
+    return path

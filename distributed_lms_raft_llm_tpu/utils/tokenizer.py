@@ -19,9 +19,11 @@ All expose: `encode(text) -> List[int]`, `decode(ids) -> str`,
 from __future__ import annotations
 
 import json
-import re
+import unicodedata
 from functools import lru_cache
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import regex  # supports \p{L}/\p{N} — required for GPT-2's exact pattern
 
 
 @lru_cache()
@@ -42,9 +44,12 @@ def _bytes_to_unicode() -> Dict[int, str]:
     return dict(zip(bs, [chr(c) for c in cs]))
 
 
-# GPT-2's pre-tokenization pattern (contractions, words, numbers, punct, ws).
-_GPT2_PAT = re.compile(
-    r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?[0-9]+| ?[^\sA-Za-z0-9]+|\s+(?!\S)|\s+"
+# GPT-2's exact pre-tokenization pattern (contractions, unicode words,
+# numbers, punctuation runs, trailing/other whitespace). \p classes matter:
+# é is a letter, not punctuation — ASCII-only approximations break parity
+# with HF on any non-English text.
+_GPT2_PAT = regex.compile(
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
 )
 
 
@@ -142,15 +147,53 @@ class WordPieceTokenizer:
     def vocab_size(self) -> int:
         return len(self.vocab)
 
+    @staticmethod
+    def _is_punct(ch: str) -> bool:
+        # BERT's definition: ASCII symbol ranges (treated as punctuation even
+        # where unicode says otherwise, e.g. $ ^ `) or any unicode P category.
+        cp = ord(ch)
+        if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+            return True
+        return unicodedata.category(ch).startswith("P")
+
+    @staticmethod
+    def _is_cjk(ch: str) -> bool:
+        cp = ord(ch)
+        return (
+            0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F
+        )
+
     def _split(self, text: str) -> List[str]:
+        """BERT basic tokenization: clean, CJK-space, lowercase+strip accents,
+        whitespace-split, then isolate punctuation (matches HF BertTokenizer's
+        BasicTokenizer so WordPiece sees identical words)."""
+        cleaned = []
+        for ch in text:
+            cp = ord(ch)
+            cat = unicodedata.category(ch)
+            if cp == 0 or cp == 0xFFFD or (cat.startswith("C") and ch not in "\t\n\r"):
+                continue
+            if ch in "\t\n\r" or cat == "Zs":
+                cleaned.append(" ")
+            elif self._is_cjk(ch):
+                cleaned.append(f" {ch} ")
+            else:
+                cleaned.append(ch)
+        text = "".join(cleaned)
         if self.lowercase:
             text = text.lower()
-        # Split on whitespace, then isolate punctuation characters.
+            text = "".join(
+                ch for ch in unicodedata.normalize("NFD", text)
+                if unicodedata.category(ch) != "Mn"
+            )
         out: List[str] = []
         for chunk in text.split():
             cur = ""
             for ch in chunk:
-                if not ch.isalnum():
+                if self._is_punct(ch):
                     if cur:
                         out.append(cur)
                         cur = ""

@@ -1,0 +1,103 @@
+"""Build local HF-format serving artifacts: checkpoint + real BPE vocab.
+
+This image has zero network egress and no HF cache, so pretrained GPT-2
+weights are unobtainable. What CAN be real offline:
+
+- the checkpoint FORMAT and loading path: a full-size HF `GPT2LMHeadModel`
+  state_dict (seeded random weights) written to `.safetensors`, exactly the
+  artifact `models.convert.load_safetensors` + `gpt2_params_from_hf`
+  consume in production;
+- the tokenizer: a REAL byte-level BPE trained with the HF `tokenizers`
+  trainer on local text, emitting the standard `vocab.json`/`merges.txt`
+  our `BPETokenizer` loads.
+
+The bench and servers then run the identical code path a user with hub
+access runs — point `--checkpoint/--vocab/--merges` at downloaded files and
+nothing else changes. Reference analogue: GUI_RAFT_LLM_SourceCode/
+tutoring_server.py:10-12 (`GPT2LMHeadModel.from_pretrained("gpt2")`).
+
+Usage: python scripts/make_local_checkpoint.py [--out data/gpt2-local]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_corpus(out_path: str, max_files: int = 400) -> str:
+    """Concatenate local prose/code into a BPE training corpus."""
+    sources: list[str] = []
+    for pattern in (
+        "/root/repo/*.md",
+        "/root/repo/distributed_lms_raft_llm_tpu/**/*.py",
+        "/root/repo/tests/*.py",
+        "/usr/lib/python3*/[a-z]*.py",
+        "/usr/share/doc/**/*.txt",
+    ):
+        sources.extend(sorted(glob.glob(pattern, recursive=True))[:max_files])
+    with open(out_path, "w", encoding="utf-8") as out:
+        for src in sources:
+            try:
+                with open(src, encoding="utf-8", errors="ignore") as f:
+                    out.write(f.read())
+                    out.write("\n")
+            except OSError:
+                continue
+    return out_path
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data/gpt2-local")
+    ap.add_argument("--model", default="gpt2",
+                    choices=["gpt2", "gpt2-medium", "gpt2-large"])
+    ap.add_argument("--vocab-size", type=int, default=50257)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    os.makedirs(args.out, exist_ok=True)
+    ckpt = os.path.join(args.out, "model.safetensors")
+    vocab = os.path.join(args.out, "vocab.json")
+    merges = os.path.join(args.out, "merges.txt")
+
+    if not (os.path.exists(vocab) and os.path.exists(merges)):
+        import tokenizers
+
+        corpus = build_corpus(os.path.join(args.out, "corpus.txt"))
+        bpe = tokenizers.ByteLevelBPETokenizer()
+        bpe.train([corpus], vocab_size=args.vocab_size, min_frequency=2,
+                  special_tokens=["<|endoftext|>"])
+        bpe.save_model(args.out)
+        os.remove(corpus)
+        print(f"trained BPE vocab: {bpe.get_vocab_size()} tokens -> {vocab}")
+
+    if not os.path.exists(ckpt):
+        import torch
+        import transformers
+
+        from distributed_lms_raft_llm_tpu.models import convert
+
+        arch = {
+            "gpt2": dict(),
+            "gpt2-medium": dict(n_embd=1024, n_layer=24, n_head=16),
+            "gpt2-large": dict(n_embd=1280, n_layer=36, n_head=20),
+        }[args.model]
+        torch.manual_seed(args.seed)
+        model = transformers.GPT2LMHeadModel(transformers.GPT2Config(**arch))
+        sd = {
+            k: v.detach().cpu().numpy()
+            for k, v in model.state_dict().items()
+            if k != "lm_head.weight"  # tied to wte
+        }
+        convert.save_safetensors(ckpt, sd)
+        n = sum(v.size for v in sd.values())
+        print(f"wrote {args.model} checkpoint: {n/1e6:.0f}M params -> {ckpt}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""End-to-end checkpoint path: HF state_dict -> .safetensors -> our loader
+-> JAX params -> logits parity with the torch model.
+
+This proves the real-weights serving path byte-for-byte: the exact file
+format HF publishes checkpoints in flows through `save_safetensors` /
+`load_safetensors` / `gpt2_params_from_hf` / `bert_params_from_hf` and the
+resulting JAX model matches torch logits. (No pretrained weights exist on
+this image — zero egress — so the state dicts come from HF-architecture
+models with random weights, which exercises the identical code path.)
+Reference analogue: GUI_RAFT_LLM_SourceCode/tutoring_server.py:10-12 and
+lms_server.py:1258-1260 load the same architectures from the HF hub.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from distributed_lms_raft_llm_tpu.models import bert as bert_lib
+from distributed_lms_raft_llm_tpu.models import convert
+from distributed_lms_raft_llm_tpu.models import gpt2 as gpt2_lib
+
+
+def _to_safetensors(path, model):
+    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    # HF ties lm_head.weight to wte; safetensors rejects shared storage dupes.
+    sd.pop("lm_head.weight", None)
+    convert.save_safetensors(str(path), sd)
+
+
+def test_gpt2_safetensors_roundtrip_matches_hf(tmp_path):
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=211, n_positions=64, n_embd=48, n_layer=3, n_head=4
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ckpt = tmp_path / "gpt2.safetensors"
+    _to_safetensors(ckpt, hf_model)
+
+    cfg = convert.gpt2_config_from_hf(hf_cfg.to_dict())
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    sd = convert.load_safetensors(str(ckpt))
+    params = convert.gpt2_params_from_hf(sd, cfg)
+
+    ids = np.array([[1, 7, 42, 5, 200, 3, 17, 9]], np.int32)
+    ours, _ = gpt2_lib.forward(params, cfg, jnp.asarray(ids))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_gpt2_safetensors_bf16_checkpoint(tmp_path):
+    """BF16-stored checkpoints load through the same path (HF publishes
+    bf16 checkpoints for large models)."""
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=32, n_embd=32, n_layer=2, n_head=2
+    )
+    torch.manual_seed(1)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    sd = {
+        k: v.detach().to(torch.bfloat16).float().numpy().astype(np.float32)
+        for k, v in hf_model.state_dict().items()
+        if k != "lm_head.weight"
+    }
+    # store as actual BF16 via jax arrays
+    sd_bf16 = {k: jnp.asarray(v, jnp.bfloat16) for k, v in sd.items()}
+    ckpt = tmp_path / "gpt2_bf16.safetensors"
+    convert.save_safetensors(str(ckpt), sd_bf16)
+
+    loaded = convert.load_safetensors(str(ckpt))
+    for k, v in sd.items():
+        np.testing.assert_allclose(loaded[k], v, atol=0, rtol=0)  # exact:
+        # values were already bf16-rounded before the save/load cycle.
+
+
+def test_bert_safetensors_roundtrip_matches_hf(tmp_path):
+    hf_cfg = transformers.BertConfig(
+        vocab_size=131,
+        hidden_size=48,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=96,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(2)
+    hf_model = transformers.BertModel(hf_cfg).eval()
+    ckpt = tmp_path / "bert.safetensors"
+    _to_safetensors(ckpt, hf_model)
+
+    cfg = convert.bert_config_from_hf(hf_cfg.to_dict())
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    sd = convert.load_safetensors(str(ckpt))
+    params = convert.bert_params_from_hf(sd, cfg)
+
+    ids = np.array([[2, 45, 99, 7, 130, 12]], np.int32)
+    mask = np.ones_like(ids, bool)
+    ours = bert_lib.forward(params, cfg, jnp.asarray(ids), jnp.asarray(mask))
+    with torch.no_grad():
+        theirs = hf_model(
+            torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_engine_loads_safetensors_checkpoint(tmp_path):
+    """The serving engine boots from a checkpoint file + real BPE vocab and
+    generates — the full real-weights path in one test."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from distributed_lms_raft_llm_tpu.engine import (
+        EngineConfig,
+        SamplingParams,
+        TutoringEngine,
+    )
+
+    # Real BPE vocab trained on the fly.
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(
+        "students ask questions instructors answer them\n" * 40, encoding="utf-8"
+    )
+    bpe = tokenizers.ByteLevelBPETokenizer()
+    bpe.train([str(corpus)], vocab_size=384, min_frequency=1,
+              special_tokens=["<|endoftext|>"])
+    bpe.save_model(str(tmp_path))
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=384, n_positions=64, n_embd=32, n_layer=2, n_head=4
+    )
+    torch.manual_seed(3)
+    _to_safetensors(tmp_path / "m.safetensors",
+                    transformers.GPT2LMHeadModel(hf_cfg).eval())
+
+    engine = TutoringEngine(
+        EngineConfig(
+            model="tiny",
+            checkpoint=str(tmp_path / "m.safetensors"),
+            vocab_path=str(tmp_path / "vocab.json"),
+            merges_path=str(tmp_path / "merges.txt"),
+            sampling=SamplingParams.reference_defaults(max_new_tokens=8),
+            length_buckets=(16,),
+            batch_buckets=(1, 2),
+        )
+    )
+    answers = engine.answer_batch(["what is an assignment?"])
+    assert len(answers) == 1
+    assert isinstance(answers[0], str)

@@ -1,0 +1,144 @@
+"""Tokenizer parity vs the HF `tokenizers` library (offline, real algorithms).
+
+The image has no pretrained vocab files and no network, so parity is proven
+the strong way: train a REAL byte-level BPE (and build a real WordPiece
+vocab) with HuggingFace `tokenizers`, then assert our pure-Python
+implementations produce identical ids/round-trips on adversarial strings.
+This is the same algorithm pair the reference relies on through
+`GPT2Tokenizer` / `BertTokenizer` (reference: GUI_RAFT_LLM_SourceCode/
+tutoring_server.py:10, lms_server.py:11).
+"""
+
+import json
+
+import pytest
+
+from distributed_lms_raft_llm_tpu.utils.tokenizer import (
+    BPETokenizer,
+    ByteTokenizer,
+    WordPieceTokenizer,
+)
+
+TRICKY = [
+    "Hello, world!",
+    "The instructor's reply: don't  panic — it's FINE.",
+    "  leading and trailing   whitespace  ",
+    "newlines\nand\ttabs\r\nmixed",
+    "numbers 123 456.789 and mixed a1b2c3",
+    "unicode: café naïve résumé Ångström",
+    "emoji 🙂 and CJK 你好世界 mixed in",
+    "contractions: I'll you're we've they'd it's can't",
+    "symbols @#$%^&*() [brackets] {braces} <angles>",
+    "",
+    "a",
+    "don't",
+    "ALLCAPS and CamelCase and snake_case",
+    "price: $19.99, 50% off!!",
+    "quoted \"strings\" and 'single' ones",
+]
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog. " * 3,
+    "Students ask questions about assignments and instructors grade them.",
+    "Distributed systems replicate state machines for fault tolerance.",
+    "don't can't won't it's we're they've I'll you'd",
+    "café naïve résumé — unicode text with punctuation!",
+    "Numbers: 0 1 2 3 42 123 456 789 1000 19.99 50%",
+    "def tokenize(text): return [t for t in pattern.findall(text)]",
+    "grading rubric: correctness 50%, style 25%, tests 25%",
+] * 50
+
+
+@pytest.fixture(scope="module")
+def trained_bpe(tmp_path_factory):
+    """Train a real byte-level BPE with HF `tokenizers`, dump vocab files."""
+    tokenizers = pytest.importorskip("tokenizers")
+    d = tmp_path_factory.mktemp("bpe")
+    corpus = d / "corpus.txt"
+    corpus.write_text("\n".join(CORPUS), encoding="utf-8")
+    hf = tokenizers.ByteLevelBPETokenizer()
+    hf.train(
+        [str(corpus)], vocab_size=800, min_frequency=1,
+        special_tokens=["<|endoftext|>"],
+    )
+    hf.save_model(str(d))
+    return hf, str(d / "vocab.json"), str(d / "merges.txt")
+
+
+def test_bpe_matches_hf_on_tricky_strings(trained_bpe):
+    hf, vocab_path, merges_path = trained_bpe
+    ours = BPETokenizer.from_files(vocab_path, merges_path)
+    assert ours.vocab_size == hf.get_vocab_size()
+    for text in TRICKY:
+        expected = hf.encode(text).ids
+        got = ours.encode(text)
+        assert got == expected, f"BPE mismatch on {text!r}: {got} != {expected}"
+
+
+def test_bpe_roundtrip(trained_bpe):
+    _, vocab_path, merges_path = trained_bpe
+    ours = BPETokenizer.from_files(vocab_path, merges_path)
+    for text in TRICKY:
+        assert ours.decode(ours.encode(text)) == text
+
+
+def test_bpe_eos_id_from_vocab(trained_bpe):
+    _, vocab_path, merges_path = trained_bpe
+    ours = BPETokenizer.from_files(vocab_path, merges_path)
+    with open(vocab_path, encoding="utf-8") as f:
+        vocab = json.load(f)
+    assert ours.eos_id == vocab["<|endoftext|>"]
+
+
+@pytest.fixture(scope="module")
+def wordpiece_vocab(tmp_path_factory):
+    """A realistic WordPiece vocab: specials, whole words, ## continuations."""
+    words = [
+        "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+        "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+        "over", "lazy", "dog", "student", "##,", "instructor", "grade",
+        "assign", "##ment", "question", "answer", "don", "'", "t", "can",
+        "won", "it", "s", "ll", "re", "ve", "d", "m", "cafe", "naive",
+        "resume", "a", "b", "c", "1", "2", "3", "##1", "##2", "##3",
+        ",", ".", "!", "?", "$", "%", "(", ")", '"', "-", "angstrom",
+        "all", "##cap", "##case", "camel", "snake", "_", "price", "19",
+        "##9", "99", "50", "off", "hello", "world", "你", "好",
+    ]
+    d = tmp_path_factory.mktemp("wp")
+    vocab_file = d / "vocab.txt"
+    vocab_file.write_text("\n".join(words), encoding="utf-8")
+    return str(vocab_file)
+
+
+def test_wordpiece_matches_hf(wordpiece_vocab):
+    tokenizers = pytest.importorskip("tokenizers")
+    hf = tokenizers.BertWordPieceTokenizer(wordpiece_vocab, lowercase=True)
+    ours = WordPieceTokenizer.from_file(wordpiece_vocab)
+    for text in TRICKY:
+        expected = hf.encode(text).ids
+        got = ours.encode(text)
+        assert got == expected, (
+            f"WordPiece mismatch on {text!r}: {got} != {expected}"
+        )
+
+
+def test_wordpiece_accent_stripping(wordpiece_vocab):
+    ours = WordPieceTokenizer.from_file(wordpiece_vocab)
+    # lowercase mode strips accents: café -> cafe, Ångström -> angstrom
+    cafe = ours.encode("café", add_special_tokens=False)
+    assert cafe == [ours.vocab["cafe"]]
+    ang = ours.encode("Ångström", add_special_tokens=False)
+    assert ang == [ours.vocab["angstrom"]]
+
+
+def test_wordpiece_unk_and_subwords(wordpiece_vocab):
+    ours = WordPieceTokenizer.from_file(wordpiece_vocab)
+    ids = ours.encode("jumps", add_special_tokens=False)
+    assert ids == [ours.vocab["jump"], ours.vocab["##s"]]
+    assert ours.encode("zzzzqqq", add_special_tokens=False) == [ours.unk_id]
+
+
+def test_byte_tokenizer_roundtrip():
+    t = ByteTokenizer()
+    for text in TRICKY:
+        assert t.decode(t.encode(text)) == text
