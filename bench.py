@@ -52,7 +52,7 @@ def ensure_local_artifacts() -> dict:
 TORCH_CPU_FALLBACK_TPS = 15.0
 
 
-def bench_tpu() -> dict:
+def bench_tpu(model: str = "gpt2", tp: int = 1) -> dict:
     import jax
 
     from distributed_lms_raft_llm_tpu.engine import (
@@ -62,13 +62,18 @@ def bench_tpu() -> dict:
     )
 
     n_chips = max(1, len(jax.devices()))
+    # The local checkpoint is gpt2-small; other sizes bench random-init
+    # (BASELINE configs 2-3: gpt2-medium single chip, gpt2-large tp-sharded
+    # — pass --tp when more than one chip is attached).
+    artifacts = ensure_local_artifacts() if model == "gpt2" else {}
     engine = TutoringEngine(
         EngineConfig(
-            model="gpt2",
+            model=model,
             sampling=SamplingParams.reference_defaults(max_new_tokens=MAX_NEW),
             length_buckets=(PROMPT_LEN, 64, 128),
             batch_buckets=(1, 2, 4, 8),
-            **ensure_local_artifacts(),
+            tp=tp,
+            **artifacts,
         )
     )
     rng = np.random.default_rng(0)
@@ -114,13 +119,18 @@ def bench_tpu() -> dict:
     }
 
 
-def bench_torch_baseline(budget_new_tokens: int = 32) -> float:
-    """Reference path: torch-CPU GPT-2-small, sequential single queries."""
+def bench_torch_baseline(model: str = "gpt2", budget_new_tokens: int = 32) -> float:
+    """Reference path: torch-CPU GPT-2 (matching size), sequential queries."""
+    arch = {
+        "gpt2": dict(),
+        "gpt2-medium": dict(n_embd=1024, n_layer=24, n_head=16),
+        "gpt2-large": dict(n_embd=1280, n_layer=36, n_head=20),
+    }[model]
     try:
         import torch
         import transformers
 
-        cfg = transformers.GPT2Config()  # gpt2-small architecture
+        cfg = transformers.GPT2Config(**arch)
         torch.manual_seed(0)
         model = transformers.GPT2LMHeadModel(cfg)
         model.eval()
@@ -147,13 +157,25 @@ def bench_torch_baseline(budget_new_tokens: int = 32) -> float:
 
 
 def main() -> None:
-    tpu = bench_tpu()
-    baseline_tps = bench_torch_baseline()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2",
+                    choices=["gpt2", "gpt2-medium", "gpt2-large"],
+                    help="BASELINE config to bench (default: the headline)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel ways (config 4: gpt2-large tp)")
+    args = ap.parse_args()
+    tpu = bench_tpu(args.model, args.tp)
+    baseline_tps = bench_torch_baseline(args.model)
+    name = {"gpt2": "gpt2_small"}.get(args.model, args.model.replace("-", "_"))
+    if args.tp > 1:
+        name += f"_tp{args.tp}"
     value = round(tpu["tokens_per_sec_per_chip"], 2)
     print(
         json.dumps(
             {
-                "metric": "gpt2_small_tutoring_decode_tokens_per_sec_per_chip"
+                "metric": f"{name}_tutoring_decode_tokens_per_sec_per_chip"
                           f"_batch{tpu['batch']}",
                 "value": value,
                 "unit": "tokens/sec/chip",

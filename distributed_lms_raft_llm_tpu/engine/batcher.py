@@ -30,10 +30,12 @@ class BatchingQueue:
         engine,
         max_batch: int = 8,
         max_wait_ms: float = 10.0,
+        metrics=None,
     ):
         self.engine = engine
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
+        self.metrics = metrics
         self._queue: asyncio.Queue[Tuple[str, asyncio.Future]] = asyncio.Queue()
         self._runner: Optional[asyncio.Task] = None
         self._closed = False
@@ -105,6 +107,14 @@ class BatchingQueue:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
+            # The engine measures time-to-first-token between its prefill and
+            # decode programs, per device chunk (requests in later chunks of
+            # an oversized group include their queueing delay).
+            ttfts = getattr(self.engine, "last_batch_ttfts", [])
+            if self.metrics is not None:
+                for i, _ in enumerate(group):
+                    if i < len(ttfts):
+                        self.metrics.hist("ttft").observe(ttfts[i])
             for (_, fut), answer in zip(group, answers):
                 if not fut.done():
                     fut.set_result(answer)

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models import convert, gpt2
+from ..models import convert, registry
 from ..parallel import mesh as mesh_lib
 from ..parallel import partition
 from ..utils import tokenizer as tok_lib
@@ -42,10 +42,11 @@ log = logging.getLogger(__name__)
 
 @dataclasses.dataclass
 class EngineConfig:
-    model: str = "gpt2"  # gpt2 | gpt2-medium | gpt2-large | gpt2-xl | tiny
+    model: str = "gpt2"  # any models/registry.py preset (gpt2* | llama*)
     checkpoint: Optional[str] = None  # .safetensors path (HF layout)
-    vocab_path: Optional[str] = None
-    merges_path: Optional[str] = None
+    vocab_path: Optional[str] = None   # GPT-2 vocab.json
+    merges_path: Optional[str] = None  # GPT-2 merges.txt
+    tokenizer_json: Optional[str] = None  # HF tokenizer.json (Llama et al.)
     sampling: SamplingParams = dataclasses.field(
         default_factory=SamplingParams.reference_defaults
     )
@@ -60,30 +61,29 @@ class EngineConfig:
     seed: int = 0
 
     @staticmethod
-    def model_config(name: str, dtype, param_dtype=None) -> gpt2.GPT2Config:
-        presets = {
-            "gpt2": gpt2.GPT2Config.small,
-            "gpt2-medium": gpt2.GPT2Config.medium,
-            "gpt2-large": gpt2.GPT2Config.large,
-            "gpt2-xl": gpt2.GPT2Config.xl,
-            "tiny": gpt2.GPT2Config.tiny,
-        }
-        if name not in presets:
-            raise ValueError(f"unknown model preset {name!r}")
-        return presets[name](dtype=dtype, param_dtype=param_dtype or dtype)
+    def model_config(name: str, dtype, param_dtype=None):
+        return registry.resolve(name, dtype, param_dtype)[1]
 
 
 class TutoringEngine:
     def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None):
         enable_compilation_cache()
         self.config = config
-        self.cfg = EngineConfig.model_config(
+        self.family, self.cfg = registry.resolve(
             config.model, config.dtype, config.param_dtype
         )
         self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1}, devices=devices)
         self.tokenizer = tok_lib.load_gpt2_tokenizer(
-            config.vocab_path, config.merges_path
+            config.vocab_path, config.merges_path, config.tokenizer_json
         )
+        if self.family.name == "llama" and config.checkpoint and not (
+            config.tokenizer_json
+        ):
+            raise ValueError(
+                "a Llama checkpoint needs its own tokenizer: pass "
+                "tokenizer_json (HF tokenizer.json) — GPT-2 BPE/byte ids "
+                "would silently map to wrong embedding rows"
+            )
         if self.tokenizer.vocab_size > self.cfg.vocab_size:
             raise ValueError(
                 f"tokenizer vocab {self.tokenizer.vocab_size} exceeds model "
@@ -102,12 +102,13 @@ class TutoringEngine:
         t0 = time.monotonic()
         if config.checkpoint:
             sd = convert.load_safetensors(config.checkpoint)
-            params = convert.gpt2_params_from_hf(sd, self.cfg)
+            params = self.family.params_from_hf(sd, self.cfg)
         else:
             log.warning("no checkpoint configured — randomly initialized %s",
                         config.model)
-            params = gpt2.init_params(jax.random.key(config.seed), self.cfg)
-        self.params = partition.shard_tree(params, self.mesh, partition.GPT2_RULES)
+            params = self.family.init_params(jax.random.key(config.seed), self.cfg)
+        rules = partition.RULES_FOR[self.family.name]
+        self.params = partition.shard_tree(params, self.mesh, rules)
         log.info("params ready in %.1fs (mesh %s)", time.monotonic() - t0,
                  dict(zip(self.mesh.axis_names, self.mesh.devices.shape)))
 
@@ -121,10 +122,12 @@ class TutoringEngine:
             sampling=self.config.sampling,
             eos_id=self.tokenizer.eos_id,
             pad_id=self.tokenizer.pad_id,
+            model=self.family,
         )
         self._prefill = jax.jit(partial(prefill, **statics))
         self._decode = jax.jit(partial(decode, **statics), donate_argnums=(1,))
         self.last_ttft_s: Optional[float] = None
+        self.last_batch_ttfts: List[float] = []
 
     def _max_prompt_len(self) -> int:
         return min(
@@ -212,13 +215,20 @@ class TutoringEngine:
             return []
         cap = max(self.config.batch_buckets)
         answers: List[str] = []
+        ttfts: List[float] = []
+        t_submit = time.monotonic()
         for start in range(0, len(prompts), cap):
             chunk = prompts[start : start + cap]
             ids, mask, _ = self.encode_prompts(chunk)
+            queued_s = time.monotonic() - t_submit
             result = self.generate_ids(ids, mask)
+            # Per-request TTFT counts from batch submission: requests in a
+            # later device chunk also waited for every earlier chunk.
+            ttfts.extend([queued_s + (self.last_ttft_s or 0.0)] * len(chunk))
             for i in range(len(chunk)):
                 n = int(result.lengths[i])
                 toks = [t for t in result.tokens[i, :n].tolist()
                         if t != self.tokenizer.eos_id]
                 answers.append(self.tokenizer.decode(toks))
+        self.last_batch_ttfts = ttfts
         return answers

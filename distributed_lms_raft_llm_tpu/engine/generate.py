@@ -31,7 +31,9 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..models import gpt2
+from ..models import registry
+from ..models.common import KVCache
+from ..models.registry import ModelFamily
 from .sampling import SamplingParams, sample_step, seen_mask_from_ids, update_seen
 
 
@@ -43,7 +45,7 @@ class GenerateResult(NamedTuple):
 class DecodeState(NamedTuple):
     """Carry between the prefill and decode programs (and loop iterations)."""
 
-    cache: gpt2.KVCache
+    cache: KVCache
     tok: jax.Array        # [B] last sampled token
     rng: jax.Array
     out: jax.Array        # [B, max_new]
@@ -62,13 +64,14 @@ def make_positions(prompt_mask: jax.Array) -> jax.Array:
 
 def prefill(
     params,
-    cfg: gpt2.GPT2Config,
+    cfg,
     input_ids: jax.Array,
     prompt_mask: jax.Array,
     rng: jax.Array,
     sampling: SamplingParams,
     eos_id: int,
     pad_id: int,
+    model: ModelFamily = registry.GPT2_FAMILY,
 ) -> DecodeState:
     """Prompt pass + first sampled token; returns the state `decode` resumes.
 
@@ -90,13 +93,13 @@ def prefill(
     positions = make_positions(prompt_mask)
     real_lens = jnp.sum(prompt_mask.astype(jnp.int32), axis=1)  # [B]
 
-    cache = gpt2.init_cache(cfg, b, cache_len, dtype=cfg.dtype)
+    cache = model.init_cache(cfg, b, cache_len, dtype=cfg.dtype)
     # Slots 0..t-1 hold the (partly padded) prompt; decode slots are real.
     kv_mask = jnp.concatenate(
         [prompt_mask.astype(jnp.bool_), jnp.ones((b, max_new), jnp.bool_)], axis=1
     )
 
-    logits, cache = gpt2.forward(
+    logits, cache = model.forward(
         params, cfg, input_ids, cache=cache, positions=positions, kv_mask=kv_mask
     )
     last_logits = logits[:, -1]  # left-padding ⇒ every row's last slot is real
@@ -125,10 +128,11 @@ def prefill(
 def decode(
     params,
     state: DecodeState,
-    cfg: gpt2.GPT2Config,
+    cfg,
     sampling: SamplingParams,
     eos_id: int,
     pad_id: int,
+    model: ModelFamily = registry.GPT2_FAMILY,
 ) -> GenerateResult:
     """Run the while_loop decode from a prefilled state to completion."""
     max_new = sampling.max_new_tokens
@@ -140,7 +144,7 @@ def decode(
         # Feed last token; its slot is t + step - 1, its position is
         # real_lens + step - 1 (both per the left-padded layout).
         pos = (s.real_lens + s.step - 1)[:, None]
-        logits, cache = gpt2.forward(
+        logits, cache = model.forward(
             params, cfg, s.tok[:, None], cache=s.cache, positions=pos,
             kv_mask=s.kv_mask,
         )
@@ -169,13 +173,14 @@ def decode(
 
 def generate(
     params,
-    cfg: gpt2.GPT2Config,
+    cfg,
     input_ids: jax.Array,
     prompt_mask: jax.Array,
     rng: jax.Array,
     sampling: SamplingParams,
     eos_id: int,
     pad_id: int,
+    model: ModelFamily = registry.GPT2_FAMILY,
 ) -> GenerateResult:
     """Sample continuations for a left-padded prompt batch (one program).
 
@@ -183,9 +188,10 @@ def generate(
     TTFT split (tests, offline batch work).
     """
     state = prefill(
-        params, cfg, input_ids, prompt_mask, rng, sampling, eos_id, pad_id
+        params, cfg, input_ids, prompt_mask, rng, sampling, eos_id, pad_id,
+        model=model,
     )
-    return decode(params, state, cfg, sampling, eos_id, pad_id)
+    return decode(params, state, cfg, sampling, eos_id, pad_id, model=model)
 
 
 def pick_bucket(length: int, buckets: Tuple[int, ...]) -> int:

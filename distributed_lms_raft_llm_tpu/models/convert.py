@@ -21,6 +21,7 @@ import numpy as np
 
 from .bert import BertConfig
 from .gpt2 import GPT2Config
+from .llama import LlamaConfig
 
 StateDict = Mapping[str, np.ndarray]
 
@@ -90,6 +91,62 @@ def gpt2_params_from_hf(sd: StateDict, cfg: GPT2Config) -> Dict[str, Any]:
             "scale": sd["ln_f.weight"].astype(pd),
             "bias": sd["ln_f.bias"].astype(pd),
         },
+    }
+
+
+def llama_config_from_hf(hf_config: Mapping[str, Any], **kw) -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=hf_config["vocab_size"],
+        max_position_embeddings=hf_config.get("max_position_embeddings", 8192),
+        hidden_size=hf_config["hidden_size"],
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        num_kv_heads=hf_config.get(
+            "num_key_value_heads", hf_config["num_attention_heads"]
+        ),
+        intermediate_size=hf_config["intermediate_size"],
+        rope_theta=hf_config.get("rope_theta", 10000.0),
+        rms_norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+        **kw,
+    )
+
+
+def llama_params_from_hf(sd: StateDict, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Map HF LlamaForCausalLM weights onto the llama.py pytree."""
+    sd = _strip_prefix({k: _np(v) for k, v in sd.items()}, "model.")
+    L = cfg.num_layers
+    pd = cfg.param_dtype
+
+    def lin_w(fmt: str) -> np.ndarray:
+        # torch Linear stores [out, in]; our dense expects [in, out].
+        return np.stack([sd[fmt.format(i)].T for i in range(L)]).astype(pd)
+
+    def vec(fmt: str) -> np.ndarray:
+        return np.stack([sd[fmt.format(i)] for i in range(L)]).astype(pd)
+
+    embed = sd["embed_tokens.weight"].astype(pd)
+    # tie_word_embeddings models ship no lm_head tensor.
+    lm_head = sd.get("lm_head.weight", embed).astype(pd)
+    p = "layers.{}."
+    return {
+        "embed": embed,
+        "blocks": {
+            "ln1": {"scale": vec(p + "input_layernorm.weight")},
+            "attn": {
+                "wq": lin_w(p + "self_attn.q_proj.weight"),
+                "wk": lin_w(p + "self_attn.k_proj.weight"),
+                "wv": lin_w(p + "self_attn.v_proj.weight"),
+                "wo": lin_w(p + "self_attn.o_proj.weight"),
+            },
+            "ln2": {"scale": vec(p + "post_attention_layernorm.weight")},
+            "mlp": {
+                "wg": lin_w(p + "mlp.gate_proj.weight"),
+                "wu": lin_w(p + "mlp.up_proj.weight"),
+                "wd": lin_w(p + "mlp.down_proj.weight"),
+            },
+        },
+        "lnf": {"scale": sd["norm.weight"].astype(pd)},
+        "lm_head": lm_head,
     }
 
 

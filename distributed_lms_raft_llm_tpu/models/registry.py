@@ -1,0 +1,59 @@
+"""Model registry: one place mapping serving presets to model families.
+
+The engine (engine/engine.py, engine/generate.py) is model-agnostic — it
+drives any family exposing the same functional surface:
+
+    init_params(rng, cfg) -> params
+    forward(params, cfg, ids, cache=, positions=, kv_mask=) -> (logits, cache)
+    init_cache(cfg, batch, max_len, dtype=) -> KVCache
+    params_from_hf(state_dict, cfg) -> params
+
+The reference hardcodes one architecture behind `from_pretrained("gpt2")`
+(reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:10); here presets
+cover the GPT-2 family (BASELINE configs 1-4) and Llama (config 5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+from . import convert, gpt2, llama
+
+
+class ModelFamily(NamedTuple):
+    name: str  # partition-rule key ("gpt2" | "llama")
+    init_params: Callable
+    forward: Callable
+    init_cache: Callable
+    params_from_hf: Callable
+
+
+GPT2_FAMILY = ModelFamily(
+    "gpt2", gpt2.init_params, gpt2.forward, gpt2.init_cache,
+    convert.gpt2_params_from_hf,
+)
+LLAMA_FAMILY = ModelFamily(
+    "llama", llama.init_params, llama.forward, llama.init_cache,
+    convert.llama_params_from_hf,
+)
+
+# preset -> (family, config factory)
+PRESETS = {
+    "gpt2": (GPT2_FAMILY, gpt2.GPT2Config.small),
+    "gpt2-medium": (GPT2_FAMILY, gpt2.GPT2Config.medium),
+    "gpt2-large": (GPT2_FAMILY, gpt2.GPT2Config.large),
+    "gpt2-xl": (GPT2_FAMILY, gpt2.GPT2Config.xl),
+    "tiny": (GPT2_FAMILY, gpt2.GPT2Config.tiny),
+    "llama3-8b": (LLAMA_FAMILY, llama.LlamaConfig.llama3_8b),
+    "llama-tiny": (LLAMA_FAMILY, llama.LlamaConfig.tiny),
+}
+
+
+def resolve(preset: str, dtype: Any, param_dtype: Any = None) -> Tuple[ModelFamily, Any]:
+    """Return (family, config) for an engine preset name."""
+    if preset not in PRESETS:
+        raise ValueError(
+            f"unknown model preset {preset!r}; have {sorted(PRESETS)}"
+        )
+    family, factory = PRESETS[preset]
+    return family, factory(dtype=dtype, param_dtype=param_dtype or dtype)

@@ -40,6 +40,19 @@ GPT2_RULES: List[Tuple[str, PartitionSpec]] = [
     (r".*", P()),
 ]
 
+# Llama family: Megatron TP like GPT-2; q/k/v/gate/up column-parallel,
+# o/down row-parallel; untied vocab-sharded embed + lm_head.
+LLAMA_RULES: List[Tuple[str, PartitionSpec]] = [
+    (r"embed$", P("tp", None)),
+    (r"lm_head$", P("tp", None)),
+    (r"blocks/attn/w[qkv]$", P(None, None, "tp")),
+    (r"blocks/attn/wo$", P(None, "tp", None)),
+    (r"blocks/mlp/w[gu]$", P(None, None, "tp")),
+    (r"blocks/mlp/wd$", P(None, "tp", None)),
+    (r"ln|lnf", P()),
+    (r".*", P()),
+]
+
 BERT_RULES: List[Tuple[str, PartitionSpec]] = [
     (r"embeddings/word$", P("tp", None)),
     (r"embeddings/(position|token_type)$", P(None, None)),
@@ -54,6 +67,13 @@ BERT_RULES: List[Tuple[str, PartitionSpec]] = [
 
 # KV cache [L, B, Hkv, T, Dh]: batch over dp, heads over tp.
 CACHE_SPEC = P(None, "dp", "tp", None, None)
+
+# Rule set per model-family name (models/registry.py ModelFamily.name).
+RULES_FOR = {
+    "gpt2": GPT2_RULES,
+    "llama": LLAMA_RULES,
+    "bert": BERT_RULES,
+}
 
 
 def tree_paths(tree: Any) -> List[str]:

@@ -51,7 +51,9 @@ class TutoringService(rpc.TutoringServicer):
             return lms_pb2.QueryResponse(success=False, response="Empty query.")
         prompt = PROMPT_TEMPLATE.format(query=request.query)
         try:
-            with self.metrics.time("ttft"):
+            # Full-answer latency for this RPC; the "ttft" histogram is fed
+            # by the batcher from the engine's measured first-token time.
+            with self.metrics.time("answer_latency"):
                 answer = await self.queue.submit(prompt)
         except Exception:
             log.exception("generation failed")
@@ -79,7 +81,8 @@ async def serve_async(
 ) -> grpc.aio.Server:
     """Start (and return) the aio server; caller awaits termination."""
     metrics = metrics or Metrics()
-    queue = BatchingQueue(engine, max_batch=max_batch, max_wait_ms=max_wait_ms)
+    queue = BatchingQueue(engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                          metrics=metrics)
     await queue.start()
     server = grpc.aio.server(
         options=[

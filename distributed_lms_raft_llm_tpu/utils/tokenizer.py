@@ -277,10 +277,49 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
 
+class HFTokenizer:
+    """Adapter over a HF `tokenizer.json` via the `tokenizers` library.
+
+    This is the format Llama-3-style checkpoints ship (tiktoken-flavored
+    byte-level BPE with a custom pre-tokenizer); wrapping the rust
+    tokenizer gives exact parity for any architecture whose vocab isn't
+    plain GPT-2 vocab.json+merges.txt. Offline: reads only the local file.
+    """
+
+    def __init__(self, path: str):
+        import tokenizers
+
+        self._tok = tokenizers.Tokenizer.from_file(path)
+        self._vocab = self._tok.get_vocab()
+        specials = [
+            t for t in ("<|end_of_text|>", "<|endoftext|>", "</s>", "<|eot_id|>")
+            if t in self._vocab
+        ]
+        self.eos_id = self._vocab[specials[0]] if specials else (
+            self._tok.get_vocab_size() - 1
+        )
+        self.pad_id = self.eos_id
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode([int(i) for i in ids], skip_special_tokens=True)
+
+
 def load_gpt2_tokenizer(
-    vocab_path: Optional[str] = None, merges_path: Optional[str] = None
+    vocab_path: Optional[str] = None,
+    merges_path: Optional[str] = None,
+    tokenizer_json: Optional[str] = None,
 ):
-    """BPE if vocab files are configured/present, else byte fallback."""
+    """Serving tokenizer resolution: HF tokenizer.json (any architecture,
+    e.g. Llama) > GPT-2 vocab.json+merges.txt BPE > byte fallback."""
+    if tokenizer_json:
+        return HFTokenizer(tokenizer_json)
     if vocab_path and merges_path:
         return BPETokenizer.from_files(vocab_path, merges_path)
     return ByteTokenizer()

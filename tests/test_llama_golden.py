@@ -1,0 +1,102 @@
+"""Llama model: golden parity vs HF transformers + engine integration.
+
+Mirrors tests/test_models_golden.py's GPT-2/BERT strategy (SURVEY.md §4d)
+for the Llama family: same tiny config in both frameworks, same weights via
+the HF conversion path, logits must agree. Covers RoPE, RMSNorm, GQA
+(num_kv_heads < num_heads), SwiGLU, and the KV-cache decode path.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from distributed_lms_raft_llm_tpu.engine import (
+    EngineConfig,
+    SamplingParams,
+    TutoringEngine,
+)
+from distributed_lms_raft_llm_tpu.models import convert, llama
+
+HF_CFG = dict(
+    vocab_size=211,
+    hidden_size=32,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,  # GQA: groups of 2
+    intermediate_size=64,
+    max_position_embeddings=64,
+    rope_theta=10000.0,
+    rms_norm_eps=1e-5,
+    tie_word_embeddings=False,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_and_ours():
+    hf_cfg = transformers.LlamaConfig(**HF_CFG)
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = convert.llama_config_from_hf(hf_cfg.to_dict())
+    cfg = dataclasses.replace(cfg, dtype=jnp.float64, param_dtype=jnp.float64)
+    params = convert.llama_params_from_hf(hf_model.state_dict(), cfg)
+    return hf_model, cfg, params
+
+
+def test_llama_logits_match_hf(hf_and_ours):
+    hf_model, cfg, params = hf_and_ours
+    ids = np.array([[3, 77, 140, 9, 201, 55, 18, 4]], np.int32)
+    ours, _ = llama.forward(params, cfg, jnp.asarray(ids))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-4, rtol=1e-4)
+
+
+def test_llama_cached_decode_matches_full(hf_and_ours):
+    """Prefill + single-token decode steps == one full forward."""
+    _, cfg, params = hf_and_ours
+    ids = np.array([[5, 9, 101, 44, 7, 63]], np.int32)
+    full, _ = llama.forward(params, cfg, jnp.asarray(ids))
+
+    cache = llama.init_cache(cfg, 1, ids.shape[1], dtype=cfg.dtype)
+    pre, cache = llama.forward(params, cfg, jnp.asarray(ids[:, :3]), cache=cache)
+    np.testing.assert_allclose(
+        np.asarray(pre), np.asarray(full[:, :3]), atol=1e-6, rtol=1e-6
+    )
+    for i in range(3, 6):
+        step, cache = llama.forward(params, cfg, jnp.asarray(ids[:, i : i + 1]),
+                                    cache=cache)
+        np.testing.assert_allclose(
+            np.asarray(step[:, 0]), np.asarray(full[:, i]), atol=1e-6, rtol=1e-6
+        )
+
+
+def test_llama_tiny_engine_generates():
+    """EngineConfig(model='llama-tiny') generates on the (virtual) mesh —
+    the BASELINE config-5 path wired end-to-end (VERDICT round-1 item 5)."""
+    engine = TutoringEngine(
+        EngineConfig(
+            model="llama-tiny",
+            sampling=SamplingParams.reference_defaults(max_new_tokens=8),
+            length_buckets=(16,),
+            batch_buckets=(1, 2),
+            tp=2,
+            dtype=jnp.float32,
+        )
+    )
+    answers = engine.answer_batch(["what is a lease?", "define quorum"])
+    assert len(answers) == 2
+    assert all(isinstance(a, str) for a in answers)
+
+
+def test_llama_gqa_cache_is_grouped():
+    cfg = llama.LlamaConfig.tiny()
+    cache = llama.init_cache(cfg, 2, 16)
+    assert cache.k.shape == (cfg.num_layers, 2, cfg.num_kv_heads, 16,
+                             cfg.head_dim)

@@ -41,9 +41,10 @@ def server_addr():
             from distributed_lms_raft_llm_tpu.engine import BatchingQueue
             from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
 
-            queue = BatchingQueue(engine, max_batch=4, max_wait_ms=20)
-            await queue.start()
             metrics = Metrics()
+            queue = BatchingQueue(engine, max_batch=4, max_wait_ms=20,
+                                  metrics=metrics)
+            await queue.start()
             rpc.add_TutoringServicer_to_server(
                 tutoring_server.TutoringService(queue, metrics), server
             )
@@ -52,16 +53,24 @@ def server_addr():
             state["port"] = port
             state["server"] = server
             state["metrics"] = metrics
+            state["queue"] = queue
             started.set()
-            await server.wait_for_termination()
 
         loop.run_until_complete(boot())
+        loop.run_forever()
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
     assert started.wait(timeout=60)
     yield f"127.0.0.1:{state['port']}", state
-    asyncio.run_coroutine_threadsafe(state["server"].stop(None), loop)
+
+    async def teardown():
+        await state["server"].stop(None)
+        await state["queue"].close()
+
+    asyncio.run_coroutine_threadsafe(teardown(), loop).result(30)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
 
 
 def test_get_llm_answer_over_wire(server_addr):
