@@ -1,0 +1,293 @@
+"""Continuous batching: slot-based decode with per-slot KV lengths.
+
+`engine.generate` runs a request group to completion — a request arriving
+one step late waits a full generation (SURVEY.md §7 hard part 3). This
+module generalizes the KV cache to per-slot lengths (the generalization
+`models/common.py` KVCache reserves the name for): the cache holds S
+independent slots; every decode step advances ALL active slots by one
+token, and the host admits/evicts requests BETWEEN steps, so a new request
+joins the running batch at the next step instead of queueing behind it.
+
+Layout differences from the bucketed path (both by design):
+- prompts are RIGHT-padded into their slot (slot position 0 = first prompt
+  token) so per-slot raggedness is just a length integer;
+- decode is a host-driven loop over a jitted single-step program (admission
+  needs host control between steps), not a device-side while_loop. The step
+  is still one fused device program: forward + sampling for all S slots.
+
+Two jitted programs, compiled once each:
+- `_prefill`: one prompt through the model into a fresh single-slot cache,
+  first token sampled; a splice program installs it into the live state at
+  the target slot.
+- `_step`: [S,1] last-tokens forward with per-row cache offsets (the
+  models' ragged-slot scatter path), fused sampling, lengths/active update.
+
+The reference has no analogue (HF `generate`, one request at a time —
+reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from functools import partial
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import convert, registry
+from ..models.common import KVCache
+from ..parallel import mesh as mesh_lib
+from ..parallel import partition
+from ..utils import tokenizer as tok_lib
+from ..utils.compilation import enable_compilation_cache
+from .engine import EngineConfig
+from .sampling import (
+    SamplingParams,
+    sample_step,
+    seen_mask_from_ids,
+    update_seen,
+)
+
+log = logging.getLogger(__name__)
+
+
+class SlotState(NamedTuple):
+    """Device-side state of all S slots."""
+
+    cache: KVCache     # k/v [L, S, H, Tmax, Dh]; length [S] per-slot
+    tok: jax.Array     # [S] last sampled token per slot
+    active: jax.Array  # [S] bool
+    seen: jax.Array    # [S, V] repetition-penalty presence mask
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt_len: int
+    tokens: List[int]
+    max_new: int
+
+
+def _prefill_program(params, cfg, ids, true_len, rng, sampling, model):
+    """[1, T] right-padded prompt -> (k, v, first_tok, seen_row).
+
+    The returned k/v are the single-slot cache [L, 1, H, Tmax, Dh] with the
+    prompt occupying positions 0..true_len-1.
+    """
+    _, t = ids.shape
+    cache = model.init_cache(cfg, 1, cfg_tmax(cfg, sampling, t), dtype=cfg.dtype)
+    kv_mask = (jnp.arange(cache.k.shape[3]) < true_len)[None, :]
+    positions = jnp.minimum(jnp.arange(t, dtype=jnp.int32), true_len - 1)[None, :]
+    logits, cache = model.forward(
+        params, cfg, ids, cache=cache, positions=positions, kv_mask=kv_mask
+    )
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0], true_len - 1, 0, keepdims=False
+    )
+    valid = (jnp.arange(t) < true_len)[None, :]
+    seen = seen_mask_from_ids(ids, valid, cfg.vocab_size)[0]
+    first = sample_step(rng, last[None, :], seen[None, :], sampling)[0]
+    return cache.k, cache.v, first, update_seen(seen[None, :], first[None])[0]
+
+
+def cfg_tmax(cfg, sampling: SamplingParams, bucket: int) -> int:
+    return min(bucket + sampling.max_new_tokens, cfg.max_position_embeddings)
+
+
+def _install_program(state: SlotState, slot, k1, v1, true_len, first, seen_row,
+                     eos_id: int) -> SlotState:
+    """Splice a prefilled slot into the live state (one fused program)."""
+    zero = jnp.zeros((), jnp.int32)
+    ck = jax.lax.dynamic_update_slice(state.cache.k, k1, (zero, slot, zero, zero, zero))
+    cv = jax.lax.dynamic_update_slice(state.cache.v, v1, (zero, slot, zero, zero, zero))
+    lengths = state.cache.length.at[slot].set(true_len)
+    return SlotState(
+        cache=KVCache(ck, cv, lengths),
+        tok=state.tok.at[slot].set(first),
+        active=state.active.at[slot].set(first != eos_id),
+        seen=state.seen.at[slot].set(seen_row),
+    )
+
+
+def _step_program(params, cfg, state: SlotState, rng, sampling, eos_id: int,
+                  pad_id: int, model) -> Tuple[SlotState, jax.Array]:
+    """One decode step for all S slots (per-row cache offsets)."""
+    tmax = state.cache.k.shape[3]
+    # Inactive/full slots write into their current position; clamp to stay
+    # in bounds — the slot is dead or about to be evicted, the data ignored.
+    offs = jnp.minimum(state.cache.length, tmax - 1)
+    cache = KVCache(state.cache.k, state.cache.v, offs)
+    kv_mask = jnp.arange(tmax)[None, :] <= offs[:, None]
+    logits, cache = model.forward(
+        params, cfg, state.tok[:, None], cache=cache, kv_mask=kv_mask
+    )
+    nxt = sample_step(rng, logits[:, 0], state.seen, sampling)
+    nxt = jnp.where(state.active, nxt, jnp.asarray(pad_id, jnp.int32))
+    still = state.active & (nxt != eos_id)
+    lengths = jnp.where(
+        state.active, jnp.minimum(state.cache.length + 1, tmax), state.cache.length
+    )
+    seen = jnp.where(
+        state.active[:, None], update_seen(state.seen, nxt), state.seen
+    )
+    return (
+        SlotState(
+            cache=KVCache(cache.k, cache.v, lengths),
+            tok=nxt,
+            active=still,
+            seen=seen,
+        ),
+        nxt,
+    )
+
+
+class PagedEngine:
+    """Slot-scheduled serving engine with mid-decode admission.
+
+    Host API (single-threaded; wrap in an executor for async serving):
+      submit(prompt) -> request id
+      step() -> list[(rid, text)] — admit pending into free slots, advance
+                one decode step, return requests that finished this step
+      drain() -> dict[rid, text] — run until no work remains
+    """
+
+    def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None,
+                 slots: Optional[int] = None):
+        enable_compilation_cache()
+        self.config = config
+        self.family, self.cfg = registry.resolve(
+            config.model, config.dtype, config.param_dtype
+        )
+        self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1},
+                                       devices=devices)
+        self.tokenizer = tok_lib.load_gpt2_tokenizer(
+            config.vocab_path, config.merges_path, config.tokenizer_json
+        )
+        self.slots = slots or max(config.batch_buckets)
+        self.bucket = max(config.length_buckets)
+        self.tmax = cfg_tmax(self.cfg, config.sampling, self.bucket)
+        if config.sampling.max_new_tokens >= self.cfg.max_position_embeddings:
+            raise ValueError("max_new_tokens must be < max_position_embeddings")
+
+        if config.checkpoint:
+            sd = convert.load_safetensors(config.checkpoint)
+            params = self.family.params_from_hf(sd, self.cfg)
+        else:
+            log.warning("no checkpoint — randomly initialized %s", config.model)
+            params = self.family.init_params(jax.random.key(config.seed), self.cfg)
+        rules = partition.RULES_FOR[self.family.name]
+        self.params = partition.shard_tree(params, self.mesh, rules)
+
+        statics = dict(cfg=self.cfg, sampling=config.sampling, model=self.family)
+        self._prefill = jax.jit(partial(_prefill_program, **statics))
+        self._install = jax.jit(partial(_install_program,
+                                        eos_id=self.tokenizer.eos_id))
+        self._step = jax.jit(
+            partial(_step_program, eos_id=self.tokenizer.eos_id,
+                    pad_id=self.tokenizer.pad_id, **statics),
+            donate_argnums=(1,),
+        )
+        self._rng = jax.random.key(config.seed)
+        self.state = self._init_state()
+        self._slot_req: List[Optional[_Request]] = [None] * self.slots
+        self._pending: List[_Request] = []
+        self._next_rid = 0
+        self.last_ttft_s: Optional[float] = None
+
+    def _init_state(self) -> SlotState:
+        cache = self.family.init_cache(self.cfg, self.slots, self.tmax,
+                                       dtype=self.cfg.dtype)
+        cache = KVCache(cache.k, cache.v,
+                        jnp.zeros((self.slots,), jnp.int32))
+        return SlotState(
+            cache=cache,
+            tok=jnp.zeros((self.slots,), jnp.int32),
+            active=jnp.zeros((self.slots,), bool),
+            seen=jnp.zeros((self.slots, self.cfg.vocab_size), bool),
+        )
+
+    # ------------------------------------------------------------ host API
+
+    def submit(self, prompt: str) -> int:
+        limit = self.bucket
+        toks = self.tokenizer.encode(prompt)[-limit:] or [self.tokenizer.pad_id]
+        req = _Request(
+            rid=self._next_rid,
+            prompt_len=len(toks),
+            tokens=toks,
+            max_new=self.config.sampling.max_new_tokens,
+        )
+        self._next_rid += 1
+        self._pending.append(req)
+        return req.rid
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._pending) or any(r is not None for r in self._slot_req)
+
+    def _admit(self) -> None:
+        for slot in range(self.slots):
+            if self._slot_req[slot] is not None or not self._pending:
+                continue
+            req = self._pending.pop(0)
+            ids = np.full((1, self.bucket), self.tokenizer.pad_id, np.int32)
+            ids[0, : req.prompt_len] = req.tokens
+            self._rng, rng = jax.random.split(self._rng)
+            t0 = time.monotonic()
+            with self.mesh:
+                k1, v1, first, seen_row = self._prefill(
+                    self.params, jnp.asarray(ids),
+                    jnp.asarray(req.prompt_len, jnp.int32), rng,
+                )
+                self.state = self._install(
+                    self.state, jnp.asarray(slot, jnp.int32), k1, v1,
+                    jnp.asarray(req.prompt_len, jnp.int32), first, seen_row,
+                )
+                first_tok = int(first)
+            self.last_ttft_s = time.monotonic() - t0
+            req.tokens = [first_tok]
+            self._slot_req[slot] = req
+
+    def step(self) -> List[Tuple[int, str]]:
+        """Admit pending requests, advance one decode step, reap finished."""
+        self._admit()
+        done: List[Tuple[int, str]] = []
+        if not any(r is not None for r in self._slot_req):
+            return done
+        self._rng, rng = jax.random.split(self._rng)
+        with self.mesh:
+            self.state, toks = self._step(self.params, self.state, rng)
+            toks = np.asarray(toks)
+            active = np.asarray(self.state.active)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            tok = int(toks[slot])
+            emitted_eos = not bool(active[slot])
+            if not emitted_eos or tok != self.tokenizer.pad_id:
+                req.tokens.append(tok)
+            finished = emitted_eos or len(req.tokens) >= req.max_new
+            if finished:
+                text = self.tokenizer.decode(
+                    [t for t in req.tokens if t != self.tokenizer.eos_id]
+                )
+                done.append((req.rid, text))
+                self._slot_req[slot] = None
+                self.state = SlotState(
+                    cache=self.state.cache,
+                    tok=self.state.tok,
+                    active=self.state.active.at[slot].set(False),
+                    seen=self.state.seen,
+                )
+        return done
+
+    def drain(self) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        while self.has_work:
+            for rid, text in self.step():
+                out[rid] = text
+        return out

@@ -134,12 +134,14 @@ def forward(
 
     cache      — None for full-sequence (training / golden) mode; a KVCache
                  for incremental prefill/decode. New keys are written at slot
-                 offset `cache.length`. PRECONDITION: callers must ensure
-                 `cache.length + T <= max_len` and positions stay below
-                 `max_position_embeddings` — JAX clamps out-of-bounds
-                 dynamic_update_slice/gather indices silently, which would
-                 corrupt the newest KV slots instead of raising. The engine
-                 enforces this (engine.generate caps max_new_tokens).
+                 offset `cache.length`, which is a scalar (whole-batch
+                 offset, engine.generate) or per-row [B] (ragged slots,
+                 engine.paged — T must be 1 in that mode). PRECONDITION:
+                 callers must ensure `cache.length + T <= max_len` and
+                 positions stay below `max_position_embeddings` — JAX clamps
+                 out-of-bounds dynamic_update_slice/gather indices silently,
+                 which would corrupt the newest KV slots instead of raising.
+                 The engine enforces this (generate caps max_new_tokens).
     positions  — [B, T] indices into the learned position table. Defaults to
                  slot indices (contiguous, no padding). The engine passes
                  per-row positions when prompts are left-padded.
@@ -150,7 +152,10 @@ def forward(
     num_heads = cfg.num_heads
 
     offset = jnp.zeros((), jnp.int32) if cache is None else cache.length
-    q_slots = offset[None, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    if offset.ndim == 1 and t != 1:
+        raise ValueError("per-row cache offsets support single-token steps only")
+    off_row = offset[:, None] if offset.ndim else offset[None, None]
+    q_slots = off_row + jnp.arange(t, dtype=jnp.int32)[None, :]
     q_slots = jnp.broadcast_to(q_slots, (b, t))
     if positions is None:
         positions = q_slots
@@ -202,13 +207,22 @@ def forward(
             updated = {}
 
             def kv_fn(k_new, v_new):
-                start = (layer, zero, zero, offset, zero)
-                ck2 = jax.lax.dynamic_update_slice(
-                    ck, k_new.astype(ck.dtype)[None], start
-                )
-                cv2 = jax.lax.dynamic_update_slice(
-                    cv, v_new.astype(cv.dtype)[None], start
-                )
+                if offset.ndim == 1:  # ragged slots: scatter at per-row pos
+                    rows = jnp.arange(k_new.shape[0])
+                    ck2 = ck.at[layer, rows, :, offset, :].set(
+                        k_new[:, :, 0, :].astype(ck.dtype)
+                    )
+                    cv2 = cv.at[layer, rows, :, offset, :].set(
+                        v_new[:, :, 0, :].astype(cv.dtype)
+                    )
+                else:
+                    start = (layer, zero, zero, offset, zero)
+                    ck2 = jax.lax.dynamic_update_slice(
+                        ck, k_new.astype(ck.dtype)[None], start
+                    )
+                    cv2 = jax.lax.dynamic_update_slice(
+                        cv, v_new.astype(cv.dtype)[None], start
+                    )
                 updated["k"], updated["v"] = ck2, cv2
                 return (
                     jax.lax.dynamic_index_in_dim(ck2, layer, 0, keepdims=False),
