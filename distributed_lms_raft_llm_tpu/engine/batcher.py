@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
@@ -118,3 +118,92 @@ class BatchingQueue:
             for (_, fut), answer in zip(group, answers):
                 if not fut.done():
                     fut.set_result(answer)
+
+
+class PagedQueue:
+    """Continuous-batching front-end over `engine.paged.PagedEngine`.
+
+    Same submit()/start()/close() surface as `BatchingQueue`, different
+    scheduling: instead of coalescing a group and running it to completion,
+    the worker drives the paged engine step by step — new submissions are
+    drained into the engine *between* decode steps, so a request arriving
+    mid-decode joins the running batch at the next step rather than queueing
+    behind the whole group (the reference serves strictly one at a time —
+    reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
+    """
+
+    def __init__(self, engine, metrics=None):
+        self.engine = engine
+        self.metrics = metrics
+        self._incoming: asyncio.Queue[Tuple[str, asyncio.Future]] = asyncio.Queue()
+        self._futures: Dict[int, asyncio.Future] = {}
+        self._runner: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def start(self) -> None:
+        if self._runner is None:
+            self._runner = asyncio.create_task(self._run())
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._runner is not None:
+            self._runner.cancel()
+            try:
+                await self._runner
+            except asyncio.CancelledError:
+                pass
+            self._runner = None
+        while not self._incoming.empty():
+            _, fut = self._incoming.get_nowait()
+            if not fut.done():
+                fut.set_exception(RuntimeError("paged queue closed"))
+        for fut in self._futures.values():
+            if not fut.done():
+                fut.set_exception(RuntimeError("paged queue closed"))
+        self._futures.clear()
+
+    async def submit(self, prompt: str) -> str:
+        if self._closed:
+            raise RuntimeError("paged queue is closed")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._incoming.put((prompt, fut))
+        return await fut
+
+    def _drain_incoming(self) -> None:
+        while not self._incoming.empty():
+            prompt, fut = self._incoming.get_nowait()
+            self._futures[self.engine.submit(prompt)] = fut
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            # Idle: block until a request arrives, then admit it plus any
+            # companions that queued behind it.
+            prompt, fut = await self._incoming.get()
+            self._futures[self.engine.submit(prompt)] = fut
+            while self.engine.has_work:
+                self._drain_incoming()
+                try:
+                    # step() blocks on device compute; run off-loop so new
+                    # submissions keep landing in _incoming meanwhile.
+                    done = await loop.run_in_executor(None, self.engine.step)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    log.exception("paged step failed")
+                    for f in self._futures.values():
+                        if not f.done():
+                            f.set_exception(e)
+                    self._futures.clear()
+                    # A failed step may have donated the live state away;
+                    # rebuild it or every later request fails too.
+                    self.engine.reset()
+                    break
+                ttfts = self.engine.pop_ttfts()
+                if self.metrics is not None:
+                    for ttft in ttfts.values():
+                        self.metrics.hist("ttft").observe(ttft)
+                for rid, text in done:
+                    f = self._futures.pop(rid, None)
+                    if f is not None and not f.done():
+                        f.set_result(text)

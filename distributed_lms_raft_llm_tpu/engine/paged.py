@@ -70,9 +70,10 @@ class _Request:
     prompt_len: int
     tokens: List[int]
     max_new: int
+    submit_time: float = 0.0
 
 
-def _prefill_program(params, cfg, ids, true_len, rng, sampling, model):
+def _prefill_program(params, ids, true_len, rng, *, cfg, sampling, model):
     """[1, T] right-padded prompt -> (k, v, first_tok, seen_row).
 
     The returned k/v are the single-slot cache [L, 1, H, Tmax, Dh] with the
@@ -99,7 +100,7 @@ def cfg_tmax(cfg, sampling: SamplingParams, bucket: int) -> int:
 
 
 def _install_program(state: SlotState, slot, k1, v1, true_len, first, seen_row,
-                     eos_id: int) -> SlotState:
+                     *, eos_id: int) -> SlotState:
     """Splice a prefilled slot into the live state (one fused program)."""
     zero = jnp.zeros((), jnp.int32)
     ck = jax.lax.dynamic_update_slice(state.cache.k, k1, (zero, slot, zero, zero, zero))
@@ -113,8 +114,8 @@ def _install_program(state: SlotState, slot, k1, v1, true_len, first, seen_row,
     )
 
 
-def _step_program(params, cfg, state: SlotState, rng, sampling, eos_id: int,
-                  pad_id: int, model) -> Tuple[SlotState, jax.Array]:
+def _step_program(params, state: SlotState, rng, *, cfg, sampling,
+                  eos_id: int, pad_id: int, model) -> Tuple[SlotState, jax.Array]:
     """One decode step for all S slots (per-row cache offsets)."""
     tmax = state.cache.k.shape[3]
     # Inactive/full slots write into their current position; clamp to stay
@@ -168,10 +169,22 @@ class PagedEngine:
             config.vocab_path, config.merges_path, config.tokenizer_json
         )
         self.slots = slots or max(config.batch_buckets)
-        self.bucket = max(config.length_buckets)
+        # Clamp the prompt bucket so bucket + max_new always fits the
+        # position table (mirrors TutoringEngine._max_prompt_len — long
+        # prompts keep their tail via submit()'s truncation). Without this,
+        # a request reaching tmax mid-decode would have its newest KV slot
+        # silently overwritten by the clamped scatter in `_step_program`.
+        self.bucket = min(
+            max(config.length_buckets),
+            self.cfg.max_position_embeddings - config.sampling.max_new_tokens,
+        )
+        if self.bucket < 1:
+            raise ValueError(
+                f"max_new {config.sampling.max_new_tokens} leaves no room "
+                f"for any prompt token in the position table "
+                f"{self.cfg.max_position_embeddings}"
+            )
         self.tmax = cfg_tmax(self.cfg, config.sampling, self.bucket)
-        if config.sampling.max_new_tokens >= self.cfg.max_position_embeddings:
-            raise ValueError("max_new_tokens must be < max_position_embeddings")
 
         if config.checkpoint:
             sd = convert.load_safetensors(config.checkpoint)
@@ -184,8 +197,13 @@ class PagedEngine:
 
         statics = dict(cfg=self.cfg, sampling=config.sampling, model=self.family)
         self._prefill = jax.jit(partial(_prefill_program, **statics))
-        self._install = jax.jit(partial(_install_program,
-                                        eos_id=self.tokenizer.eos_id))
+        # The live SlotState is donated on every program that replaces it, so
+        # admissions and steps update the multi-slot KV cache in place instead
+        # of copying it (a full cache round-trip of HBM traffic otherwise).
+        self._install = jax.jit(
+            partial(_install_program, eos_id=self.tokenizer.eos_id),
+            donate_argnums=(0,),
+        )
         self._step = jax.jit(
             partial(_step_program, eos_id=self.tokenizer.eos_id,
                     pad_id=self.tokenizer.pad_id, **statics),
@@ -197,6 +215,9 @@ class PagedEngine:
         self._pending: List[_Request] = []
         self._next_rid = 0
         self.last_ttft_s: Optional[float] = None
+        # Per-request time-to-first-token (submit() -> first token on host),
+        # keyed by rid; the serving queue pops these into its histogram.
+        self.ttfts: Dict[int, float] = {}
 
     def _init_state(self) -> SlotState:
         cache = self.family.init_cache(self.cfg, self.slots, self.tmax,
@@ -220,16 +241,48 @@ class PagedEngine:
             prompt_len=len(toks),
             tokens=toks,
             max_new=self.config.sampling.max_new_tokens,
+            submit_time=time.monotonic(),
         )
         self._next_rid += 1
         self._pending.append(req)
         return req.rid
 
+    def warmup(self) -> float:
+        """Compile the prefill/install/step programs; returns seconds."""
+        t0 = time.monotonic()
+        rid = self.submit("warmup")
+        self.drain()
+        self.ttfts.pop(rid, None)
+        return time.monotonic() - t0
+
     @property
     def has_work(self) -> bool:
         return bool(self._pending) or any(r is not None for r in self._slot_req)
 
+    def pop_ttfts(self) -> Dict[int, float]:
+        """Drain the per-request TTFT measurements recorded since last call."""
+        out, self.ttfts = self.ttfts, {}
+        return out
+
+    def reset(self) -> None:
+        """Discard all in-flight work and rebuild a clean device state.
+
+        Needed after a failed step: `_step` donates the live SlotState, so an
+        exception mid-step can leave `self.state` pointing at deleted
+        buffers — every subsequent step would fail. Callers (the serving
+        queue) fail the affected requests and reset the engine.
+        """
+        self.state = self._init_state()
+        self._slot_req = [None] * self.slots
+        self._pending = []
+        self.ttfts = {}
+
     def _admit(self) -> None:
+        # All free slots fill before any host sync: the prefill+install
+        # programs for every admitted request dispatch back-to-back and
+        # pipeline on device; one blocking readback at the end fetches every
+        # first token (instead of a per-request round-trip stall).
+        admitted: List[Tuple[int, _Request, jax.Array]] = []
         for slot in range(self.slots):
             if self._slot_req[slot] is not None or not self._pending:
                 continue
@@ -237,7 +290,6 @@ class PagedEngine:
             ids = np.full((1, self.bucket), self.tokenizer.pad_id, np.int32)
             ids[0, : req.prompt_len] = req.tokens
             self._rng, rng = jax.random.split(self._rng)
-            t0 = time.monotonic()
             with self.mesh:
                 k1, v1, first, seen_row = self._prefill(
                     self.params, jnp.asarray(ids),
@@ -247,10 +299,17 @@ class PagedEngine:
                     self.state, jnp.asarray(slot, jnp.int32), k1, v1,
                     jnp.asarray(req.prompt_len, jnp.int32), first, seen_row,
                 )
-                first_tok = int(first)
-            self.last_ttft_s = time.monotonic() - t0
-            req.tokens = [first_tok]
+            admitted.append((slot, req, first))
+        if not admitted:
+            return
+        firsts = jax.device_get([f for _, _, f in admitted])  # one sync
+        now = time.monotonic()
+        for (slot, req, _), first in zip(admitted, firsts):
+            req.tokens = [int(first)]
             self._slot_req[slot] = req
+            ttft = now - req.submit_time
+            self.ttfts[req.rid] = ttft
+            self.last_ttft_s = ttft
 
     def step(self) -> List[Tuple[int, str]]:
         """Admit pending requests, advance one decode step, reap finished."""
@@ -270,7 +329,14 @@ class PagedEngine:
             emitted_eos = not bool(active[slot])
             if not emitted_eos or tok != self.tokenizer.pad_id:
                 req.tokens.append(tok)
-            finished = emitted_eos or len(req.tokens) >= req.max_new
+            # Third clause: force-finish a slot whose cache hit tmax (only
+            # reachable if a caller bypasses the __init__ length check) —
+            # past tmax the clamped scatter would corrupt its newest KV slot.
+            finished = (
+                emitted_eos
+                or len(req.tokens) >= req.max_new
+                or req.prompt_len + len(req.tokens) >= self.tmax
+            )
             if finished:
                 text = self.tokenizer.decode(
                     [t for t in req.tokens if t != self.tokenizer.eos_id]

@@ -26,7 +26,14 @@ from typing import Optional
 
 import grpc
 
-from ..engine import BatchingQueue, EngineConfig, SamplingParams, TutoringEngine
+from ..engine import (
+    BatchingQueue,
+    EngineConfig,
+    PagedEngine,
+    PagedQueue,
+    SamplingParams,
+    TutoringEngine,
+)
 from ..proto import lms_pb2, rpc
 from ..utils.metrics import Metrics
 
@@ -72,17 +79,25 @@ async def _report_metrics(metrics: Metrics, period_s: float) -> None:
 
 async def serve_async(
     port: int,
-    engine: TutoringEngine,
+    engine,
     *,
     max_batch: int = 8,
     max_wait_ms: float = 10.0,
     metrics: Optional[Metrics] = None,
     metrics_period_s: float = 60.0,
 ) -> grpc.aio.Server:
-    """Start (and return) the aio server; caller awaits termination."""
+    """Start (and return) the aio server; caller awaits termination.
+
+    `engine` is a `TutoringEngine` (group-batched generate) or a
+    `PagedEngine` (continuous batching: requests join the running batch
+    mid-decode); the matching queue front-end is picked automatically.
+    """
     metrics = metrics or Metrics()
-    queue = BatchingQueue(engine, max_batch=max_batch, max_wait_ms=max_wait_ms,
-                          metrics=metrics)
+    if isinstance(engine, PagedEngine):
+        queue = PagedQueue(engine, metrics=metrics)
+    else:
+        queue = BatchingQueue(engine, max_batch=max_batch,
+                              max_wait_ms=max_wait_ms, metrics=metrics)
     await queue.start()
     server = grpc.aio.server(
         options=[
@@ -116,6 +131,14 @@ def main(argv=None) -> None:
     parser.add_argument("--max-new-tokens", type=int, default=128)
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--max-wait-ms", type=float, default=10.0)
+    parser.add_argument(
+        "--paged", action="store_true",
+        help="continuous batching: requests join the running batch "
+        "mid-decode instead of waiting for the current group",
+    )
+    parser.add_argument("--slots", type=int, default=None,
+                        help="paged engine decode slots (default: max batch "
+                        "bucket)")
     parser.add_argument("--no-warmup", action="store_true")
     parser.add_argument(
         "--jax-platform", default="default", choices=["cpu", "default"],
@@ -132,18 +155,23 @@ def main(argv=None) -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     sampling = SamplingParams.reference_defaults(max_new_tokens=args.max_new_tokens)
-    engine = TutoringEngine(
-        EngineConfig(
-            model=args.model,
-            checkpoint=args.checkpoint,
-            vocab_path=args.vocab,
-            merges_path=args.merges,
-            sampling=sampling,
-            tp=args.tp,
-        )
+    config = EngineConfig(
+        model=args.model,
+        checkpoint=args.checkpoint,
+        vocab_path=args.vocab,
+        merges_path=args.merges,
+        sampling=sampling,
+        tp=args.tp,
     )
+    if args.paged:
+        # --max-batch bounds concurrency in both modes: it is the decode
+        # slot count here (unless --slots overrides it explicitly).
+        engine = PagedEngine(config, slots=args.slots or args.max_batch)
+    else:
+        engine = TutoringEngine(config)
     if not args.no_warmup:
-        secs = engine.warmup(batch=args.max_batch)
+        secs = (engine.warmup() if args.paged
+                else engine.warmup(batch=args.max_batch))
         log.info("warmup compile took %.1fs", secs)
 
     async def run():

@@ -1,0 +1,154 @@
+"""PagedEngine continuous batching: parity, mid-decode admission, slot reuse.
+
+The round-1 done-criterion for continuous batching: a request submitted
+mid-decode completes without waiting for the running group (the reference
+serves strictly one request at a time — reference:
+GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from distributed_lms_raft_llm_tpu.engine import (
+    EngineConfig,
+    PagedEngine,
+    PagedQueue,
+    SamplingParams,
+    TutoringEngine,
+)
+from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+MAX_NEW = 8
+
+PROMPTS = ["what is raft?", "hello world", "explain paging", "k"]
+
+
+def make_config(**kw):
+    kw.setdefault("sampling", SamplingParams.greedy(max_new_tokens=MAX_NEW))
+    return EngineConfig(
+        model="tiny",
+        length_buckets=(16,),
+        batch_buckets=(1, 2, 4),
+        dtype=jax.numpy.float32,
+        **kw,
+    )
+
+
+def test_greedy_parity_with_bucketed_engine():
+    """Same params (same seed), greedy sampling: the paged engine must emit
+    exactly what the bucketed engine emits, despite its different padding
+    (right vs left) and per-slot ragged cache layout."""
+    cfg = make_config()
+    expected = TutoringEngine(cfg).answer_batch(list(PROMPTS))
+    paged = PagedEngine(cfg, slots=4)
+    rids = [paged.submit(p) for p in PROMPTS]
+    out = paged.drain()
+    assert [out[rid] for rid in rids] == expected
+
+
+def test_mid_decode_admission_completes_without_waiting():
+    paged = PagedEngine(make_config(), slots=2)
+    paged.submit("a long question about distributed consensus and logs")
+    for _ in range(3):
+        paged.step()  # A is now mid-decode
+    b = paged.submit("b")
+    finished = {}
+    steps_after_b = 0
+    while paged.has_work and steps_after_b < 3 * MAX_NEW:
+        steps_after_b += 1
+        for rid, text in paged.step():
+            finished.setdefault(rid, steps_after_b)
+        if steps_after_b == 1:
+            # B was admitted into a free slot immediately, joining the
+            # running batch rather than queueing behind it.
+            in_slots = {r.rid for r in paged._slot_req if r is not None}
+            assert b in in_slots or b in finished
+    assert b in finished
+    # B finished within its own generation budget (+1 for the admission
+    # step) — it did not wait for A's remaining decode.
+    assert finished[b] <= MAX_NEW + 1
+
+
+def test_slot_reuse_evict_then_readmit():
+    """slots=1 forces the second request through an evict→re-admit cycle in
+    the same slot; outputs must match sequential fresh-drain runs."""
+    cfg = make_config()
+    sequential = PagedEngine(cfg, slots=1)
+    r1 = sequential.submit(PROMPTS[0])
+    out1 = sequential.drain()
+    r2 = sequential.submit(PROMPTS[1])
+    out2 = sequential.drain()
+
+    fresh = PagedEngine(cfg, slots=1)
+    f1 = fresh.submit(PROMPTS[0])
+    f2 = fresh.submit(PROMPTS[1])
+    both = fresh.drain()
+    assert both[f1] == out1[r1]
+    assert both[f2] == out2[r2]
+
+
+def test_overflow_budget_clamped_or_rejected():
+    # tiny's position table is 64. A budget of 50 clamps the prompt bucket
+    # to 14 so bucket + max_new always fits (no silent KV corruption at
+    # tmax); a budget leaving no prompt room at all is rejected.
+    eng = PagedEngine(
+        make_config(sampling=SamplingParams.greedy(max_new_tokens=50)), slots=2
+    )
+    assert eng.bucket == 14
+    assert eng.bucket + 50 <= 64
+    rid = eng.submit("a prompt much longer than fourteen byte-tokens")
+    assert isinstance(eng.drain()[rid], str)
+    with pytest.raises(ValueError, match="no room"):
+        PagedEngine(
+            make_config(sampling=SamplingParams.greedy(max_new_tokens=64)),
+            slots=2,
+        )
+
+
+def test_paged_queue_serves_concurrent_requests():
+    metrics = Metrics()
+    engine = PagedEngine(make_config(), slots=2)
+
+    async def run():
+        q = PagedQueue(engine, metrics=metrics)
+        await q.start()
+        answers = await asyncio.gather(
+            *[q.submit(f"query number {i}") for i in range(5)]
+        )
+        await q.close()
+        return answers
+
+    answers = asyncio.run(run())
+    assert len(answers) == 5
+    assert all(isinstance(a, str) for a in answers)
+    # Per-request TTFT landed in the serving histogram.
+    assert metrics.hist("ttft").snapshot()["count"] == 5
+
+
+def test_paged_queue_recovers_after_step_failure():
+    """A failed step fails its in-flight requests but must not poison the
+    engine (step donates the live state) — later requests still serve."""
+    engine = PagedEngine(make_config(), slots=2)
+    orig_step = engine.step
+    armed = {"on": True}
+
+    def flaky_step():
+        if armed["on"]:
+            armed["on"] = False
+            raise RuntimeError("injected device failure")
+        return orig_step()
+
+    engine.step = flaky_step
+
+    async def run():
+        q = PagedQueue(engine)
+        await q.start()
+        with pytest.raises(RuntimeError, match="injected"):
+            await q.submit("first")
+        answer = await q.submit("second")
+        await q.close()
+        return answer
+
+    assert isinstance(asyncio.run(run()), str)
