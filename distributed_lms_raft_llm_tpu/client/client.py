@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import time
+import uuid
 from typing import Callable, Dict, List, Optional, Sequence, TypeVar
 
 import grpc
@@ -95,7 +96,14 @@ class LMSClient:
         raise NoLeader(f"no leader found among {self.servers}")
 
     def _call(self, fn: Callable[[rpc.LMSStub], T]) -> T:
-        """Run an op against the leader; re-resolve + retry on transients."""
+        """Run an op against the leader; re-resolve + retry on transients.
+
+        Mutating callers bake a `request_id` into the request (see
+        `_request_id`): the SAME id is re-sent on every retry, so if the
+        original proposal actually committed (e.g. the client timed out
+        waiting for the quorum ACK), the replicated applier drops the
+        duplicate instead of double-applying a non-idempotent command.
+        """
         last_error: Optional[Exception] = None
         for attempt in range(self.rpc_retries + 1):
             try:
@@ -108,6 +116,11 @@ class LMSClient:
                     raise
                 log.info("rpc failed (%s); re-resolving leader", e.code())
         raise last_error  # type: ignore[misc]
+
+    @staticmethod
+    def _request_id() -> str:
+        """Idempotency key for one logical mutation (stable across retries)."""
+        return uuid.uuid4().hex
 
     # ----------------------------------------------------------------- api
 
@@ -147,32 +160,36 @@ class LMSClient:
         return resp.success
 
     def upload_assignment(self, filename: str, content: bytes) -> bool:
+        rid = self._request_id()
         return self._call(
             lambda s: s.Post(
                 lms_pb2.PostRequest(
                     token=self.token or "", type="assignment",
-                    file=content, filename=filename,
+                    file=content, filename=filename, request_id=rid,
                 ),
                 timeout=self.rpc_timeout,
             )
         ).success
 
     def upload_course_material(self, filename: str, content: bytes) -> bool:
+        rid = self._request_id()
         return self._call(
             lambda s: s.Post(
                 lms_pb2.PostRequest(
                     token=self.token or "", type="course_material",
-                    file=content, filename=filename,
+                    file=content, filename=filename, request_id=rid,
                 ),
                 timeout=self.rpc_timeout,
             )
         ).success
 
     def ask_instructor(self, query: str) -> bool:
+        rid = self._request_id()
         return self._call(
             lambda s: s.Post(
                 lms_pb2.PostRequest(
-                    token=self.token or "", type="query", data=query
+                    token=self.token or "", type="query", data=query,
+                    request_id=rid,
                 ),
                 timeout=self.rpc_timeout,
             )
@@ -197,10 +214,12 @@ class LMSClient:
         return list(resp.entries)
 
     def grade(self, student: str, grade: str):
+        rid = self._request_id()
         return self._call(
             lambda s: s.GradeAssignment(
                 lms_pb2.GradeRequest(
-                    token=self.token or "", studentId=student, grade=grade
+                    token=self.token or "", studentId=student, grade=grade,
+                    request_id=rid,
                 ),
                 timeout=self.rpc_timeout,
             )
@@ -225,10 +244,12 @@ class LMSClient:
         return list(resp.entries)
 
     def respond_to_query(self, student: str, response: str) -> bool:
+        rid = self._request_id()
         return self._call(
             lambda s: s.RespondToQuery(
                 lms_pb2.PostRequest(
-                    token=self.token or "", studentId=student, data=response
+                    token=self.token or "", studentId=student, data=response,
+                    request_id=rid,
                 ),
                 timeout=self.rpc_timeout,
             )

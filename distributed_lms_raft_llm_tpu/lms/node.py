@@ -16,6 +16,7 @@ snapshot index → WAL suffix replays through the same apply path.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 from typing import Dict, Optional
@@ -62,9 +63,15 @@ class LMSNode:
             storage,
             transport,
             apply_cb=self._apply,
+            install_cb=self._install_snapshot,
             config=raft_config,
             last_applied=applied,
         )
+        # Compact the WAL up to the restored snapshot and prime the
+        # InstallSnapshot payload for lagging peers (a restart loses the
+        # in-memory copy; the core keeps only (index, term) durably).
+        if applied > 0:
+            self.node.compact(applied, self._snapshot_bytes())
 
     # ------------------------------------------------------------------ api
 
@@ -77,6 +84,22 @@ class LMSNode:
 
     # ------------------------------------------------------------ internals
 
+    def _snapshot_bytes(self) -> bytes:
+        # NO sort_keys: the applied_requests idempotency ledger dedupes by
+        # dict insertion order (oldest-first eviction must match on every
+        # replica); sorting would rebuild snapshot-installed replicas in
+        # lexicographic order and diverge them from live-applied ones.
+        return json.dumps(self.state.data).encode()
+
+    def _install_snapshot(self, index: int, data: bytes) -> None:
+        """A leader's InstallSnapshot replaced our log prefix: swap in its
+        state wholesale, persist it, and resume applying after `index`."""
+        self.state.replace(json.loads(data.decode()))
+        self._last_applied_index = index
+        self.snapshots.save(self.state, index)
+        self._applies_since_snapshot = 0
+        log.info("installed leader snapshot at index %d", index)
+
     def _apply(self, index: int, entry: Entry) -> None:
         op, args = decode_command(entry.command)
         self.state.apply(op, args)
@@ -85,6 +108,10 @@ class LMSNode:
         if self._applies_since_snapshot >= self.snapshot_every:
             self.snapshots.save(self.state, index)
             self._applies_since_snapshot = 0
+            # The state snapshot at `index` is durable: the WAL prefix it
+            # covers can go, bounding the log (the reference's analogue grew
+            # forever — it never persisted, let alone compacted).
+            self.node.compact(index, self._snapshot_bytes())
         # Bulk data plane: after the metadata commits, the leader streams the
         # file itself to followers (reference lms_server.py:1328-1334).
         if op in ("PostAssignment", "PostCourseMaterial") and self.node.is_leader:

@@ -33,6 +33,7 @@ import grpc
 from ..proto import lms_pb2, rpc
 from ..raft import NotLeader, encode_command
 from ..utils import pdf
+from ..utils.auth import sign_query
 from ..utils.metrics import Metrics
 from .persistence import BlobStore
 from .state import LMSState, hash_password
@@ -51,7 +52,10 @@ class LMSServicer(rpc.LMSServicer):
         *,
         gate=None,                 # engine.RelevanceGate (optional)
         tutoring_address: Optional[str] = None,
+        tutoring_auth_key: Optional[str] = None,
         metrics: Optional[Metrics] = None,
+        peer_addresses: Optional[Dict[int, str]] = None,
+        self_id: Optional[int] = None,
     ):
         self.node = node
         self.state = state
@@ -59,8 +63,16 @@ class LMSServicer(rpc.LMSServicer):
         self.gate = gate
         self.metrics = metrics or Metrics()
         self._tutoring_address = tutoring_address
+        self._tutoring_auth_key = tutoring_auth_key
         self._tutoring_channel: Optional[grpc.aio.Channel] = None
         self._tutoring_stub = None
+        # Peer map for blob anti-entropy (fetch-on-miss); empty = disabled.
+        self._peer_addresses = dict(peer_addresses or {})
+        self._self_id = self_id
+        # Negative cache: rel_path -> monotonic deadline before which peer
+        # fetches are not retried. Without it, every read referencing a
+        # permanently lost blob would stall on a full peer sweep.
+        self._blob_missing: Dict[str, float] = {}
 
     # ------------------------------------------------------------- helpers
 
@@ -98,6 +110,57 @@ class LMSServicer(rpc.LMSServicer):
             self._tutoring_stub = rpc.TutoringStub(self._tutoring_channel)
         return self._tutoring_stub
 
+    async def _blob(self, rel_path: str) -> bytes:
+        """Blob bytes for committed metadata; fetch-on-miss from peers.
+
+        A node can hold committed metadata without the blob (it missed the
+        leader's fire-and-forget push — e.g. it was partitioned during the
+        upload, or wiped and restored from snapshot). Rather than serving
+        `success=True` with empty file bytes, pull the blob from a peer
+        (leader first) via the additive `FetchFile` RPC and store it, so the
+        miss heals permanently.
+        """
+        loop = asyncio.get_running_loop()
+        content = await loop.run_in_executor(None, self.blobs.get, rel_path)
+        if content is not None:
+            return content
+        now = asyncio.get_running_loop().time()
+        if self._blob_missing.get(rel_path, 0.0) > now:
+            return b""  # recently swept the peers; don't stall every read
+        leader = self.node.leader_id
+        ordered = sorted(
+            self._peer_addresses,
+            key=lambda pid: (pid != leader, pid),
+        )
+        for pid in ordered:
+            if pid == self._self_id:
+                continue
+            try:
+                # Same 50 MiB cap the upload path accepts — the default
+                # 4 MiB receive cap would make any larger blob unfetchable.
+                async with grpc.aio.insecure_channel(
+                    self._peer_addresses[pid],
+                    options=[("grpc.max_receive_message_length",
+                              50 * 1024 * 1024)],
+                ) as channel:
+                    stub = rpc.FileTransferServiceStub(channel)
+                    resp = await stub.FetchFile(
+                        lms_pb2.FetchFileRequest(path=rel_path), timeout=5
+                    )
+                if resp.found:
+                    await loop.run_in_executor(
+                        None, self.blobs.put, rel_path, resp.content
+                    )
+                    self.metrics.inc("blob_fetch_on_miss")
+                    self._blob_missing.pop(rel_path, None)
+                    return resp.content
+            except grpc.RpcError as e:
+                log.info("blob fetch %s from %d failed: %s", rel_path, pid,
+                         e.code())
+        log.warning("blob %s missing everywhere reachable", rel_path)
+        self._blob_missing[rel_path] = now + 30.0
+        return b""
+
     # ---------------------------------------------------------------- auth
 
     async def Register(self, request, context):
@@ -114,22 +177,30 @@ class LMSServicer(rpc.LMSServicer):
             return lms_pb2.RegisterResponse(
                 success=False, message=f"User {request.username} already exists."
             )
-        pw_hash = hash_password(request.password)
+        # Salt generated here, carried in the command: every replica applies
+        # the same (salt, hash) pair, so the KDF stays deterministic across
+        # the cluster while each user gets a unique salt.
+        salt = os.urandom(16).hex()
+        pw_hash = hash_password(request.password, salt)
         await self._propose(
             "Register",
             {
                 "username": request.username,
                 "password_hash": pw_hash,
+                "salt": salt,
                 "role": request.role,
             },
             context,
         )
         # Re-check after commit: with concurrent registrations of the same
         # name, the applier is first-writer-wins — only tell the winner it
-        # succeeded.
-        won = self.state.data["users"].get(request.username, {}).get(
-            "password"
-        ) == pw_hash
+        # succeeded. Checked via authentication + role (not hash equality,
+        # whose per-proposal salt would fail a retried proposal that lost to
+        # the caller's own earlier commit; role, because a concurrent loser
+        # with the same password must not be told its different role won).
+        won = self.state.check_password(
+            request.username, request.password
+        ) and self.state.role_of(request.username) == request.role
         msg = (
             f"User {request.username} registered as {request.role}."
             if won
@@ -167,6 +238,9 @@ class LMSServicer(rpc.LMSServicer):
         # Stored/echoed filenames are basenamed: a hostile client must not be
         # able to plant "../" paths that peers or downloading clients write.
         filename = os.path.basename(request.filename)
+        # Client idempotency key: rides in the command so the replicated
+        # applier drops a retried mutation whose original already committed.
+        rid = request.request_id
 
         if role == "instructor" and request.type == "course_material":
             rel = os.path.join("materials", filename)
@@ -175,7 +249,7 @@ class LMSServicer(rpc.LMSServicer):
             ok = await self._propose(
                 "PostCourseMaterial",
                 {"instructor": username, "filename": filename,
-                 "filepath": rel},
+                 "filepath": rel, "request_id": rid},
                 context,
             )
             return lms_pb2.PostResponse(success=ok)
@@ -190,14 +264,16 @@ class LMSServicer(rpc.LMSServicer):
             ok = await self._propose(
                 "PostAssignment",
                 {"student": username, "filename": filename,
-                 "filepath": rel, "text": text},
+                 "filepath": rel, "text": text, "request_id": rid},
                 context,
             )
             return lms_pb2.PostResponse(success=ok)
 
         if role == "student" and request.type == "query":
             ok = await self._propose(
-                "AskQuery", {"username": username, "query": request.data},
+                "AskQuery",
+                {"username": username, "query": request.data,
+                 "request_id": rid},
                 context,
             )
             return lms_pb2.PostResponse(success=ok)
@@ -221,7 +297,8 @@ class LMSServicer(rpc.LMSServicer):
             )
         ok = await self._propose(
             "GradeAssignment",
-            {"student": request.studentId, "grade": request.grade},
+            {"student": request.studentId, "grade": request.grade,
+             "request_id": request.request_id},
             context,
         )
         msg = "Grade recorded." if ok else "Grading failed (no leader?)."
@@ -237,7 +314,7 @@ class LMSServicer(rpc.LMSServicer):
         ok = await self._propose(
             "RespondToQuery",
             {"instructor": username, "student": request.studentId,
-             "response": request.data},
+             "response": request.data, "request_id": request.request_id},
             grpc_context,
         )
         return lms_pb2.PostResponse(success=ok)
@@ -257,11 +334,8 @@ class LMSServicer(rpc.LMSServicer):
                 return lms_pb2.GetResponse(
                     success=True, message="No course materials available."
                 )
-            loop = asyncio.get_running_loop()
             for material in materials:
-                content = await loop.run_in_executor(
-                    None, self.blobs.get, material["filepath"]
-                ) or b""
+                content = await self._blob(material["filepath"])
                 entries.append(
                     lms_pb2.DataEntry(
                         id="1",
@@ -273,12 +347,9 @@ class LMSServicer(rpc.LMSServicer):
             return lms_pb2.GetResponse(success=True, entries=entries)
 
         if request.type == "student_list" and role == "instructor":
-            loop = asyncio.get_running_loop()
             for student, assignments in self.state.data["assignments"].items():
                 for assignment in assignments:
-                    content = await loop.run_in_executor(
-                        None, self.blobs.get, assignment["filepath"]
-                    ) or b""
+                    content = await self._blob(assignment["filepath"])
                     entries.append(
                         lms_pb2.DataEntry(
                             id=student,
@@ -380,9 +451,17 @@ class LMSServicer(rpc.LMSServicer):
                 return lms_pb2.QueryResponse(
                     success=False, response="Tutoring service not configured."
                 )
+            # With a shared key configured, the forwarded query carries an
+            # HMAC ticket in the token field; the tutoring node answers only
+            # ticketed queries, closing the direct-dial gate bypass.
+            fwd_token = (
+                sign_query(self._tutoring_auth_key, request.query)
+                if self._tutoring_auth_key
+                else request.token
+            )
             try:
                 answer = await stub.GetLLMAnswer(
-                    lms_pb2.QueryRequest(token=request.token, query=request.query),
+                    lms_pb2.QueryRequest(token=fwd_token, query=request.query),
                     timeout=120,
                 )
             except grpc.RpcError as e:
@@ -421,6 +500,20 @@ class FileTransferServicer(rpc.FileTransferServiceServicer):
                 writer.abort()
             log.warning("SendFile failed: %s", e)
             return lms_pb2.FileTransferResponse(status=f"error: {e}")
+
+    async def FetchFile(self, request, context):
+        """Pull path for blob anti-entropy (see LMSServicer._blob)."""
+        loop = asyncio.get_running_loop()
+        try:
+            content = await loop.run_in_executor(
+                None, self.blobs.get, request.path
+            )
+        except ValueError:  # path escapes the blob root: not found, not 500
+            log.warning("FetchFile rejected traversal path %r", request.path)
+            return lms_pb2.FetchFileResponse(found=False)
+        if content is None:
+            return lms_pb2.FetchFileResponse(found=False)
+        return lms_pb2.FetchFileResponse(found=True, content=content)
 
     async def ReplicateData(self, request, context):
         """Direct blob push (metadata rides Raft; this is the bulk path)."""

@@ -30,6 +30,8 @@ from .messages import (
     AppendRequest,
     AppendResponse,
     Entry,
+    InstallSnapshotRequest,
+    InstallSnapshotResponse,
     VoteRequest,
     VoteResponse,
 )
@@ -52,12 +54,16 @@ class RaftConfig:
         election_timeout_max: float = 0.30,
         heartbeat_interval: float = 0.05,
         max_entries_per_append: int = 64,
+        snapshot_resend_interval: float = 2.0,
     ):
         assert election_timeout_min > 2 * heartbeat_interval
         self.election_timeout_min = election_timeout_min
         self.election_timeout_max = election_timeout_max
         self.heartbeat_interval = heartbeat_interval
         self.max_entries_per_append = max_entries_per_append
+        # Unlike heartbeats, snapshot payloads are unbounded — don't re-send
+        # one to the same peer more often than this while awaiting its ack.
+        self.snapshot_resend_interval = snapshot_resend_interval
 
 
 class RaftCore:
@@ -78,8 +84,15 @@ class RaftCore:
         self.config = config or RaftConfig()
         self._rng = random.Random(node_id if seed is None else seed)
 
-        # Persistent state (restored from storage).
-        self.current_term, self.voted_for, self.log = storage.load()
+        # Persistent state (restored from storage). The log may be
+        # compacted: `snapshot_index/term` anchor absolute indexing, and
+        # `self.log` holds entries snapshot_index+1 .. last (Raft §7).
+        (self.current_term, self.voted_for, self.log,
+         self.snapshot_index, self.snapshot_term) = storage.load()
+        # Application snapshot bytes at exactly snapshot_index, for
+        # InstallSnapshot to lagging peers. Not persisted here — the app
+        # primes it via `compact()` (at boot and after each state snapshot).
+        self.snapshot_data: Optional[bytes] = None
 
         # Volatile state.
         self.role = Role.FOLLOWER
@@ -87,13 +100,41 @@ class RaftCore:
         # A state-machine snapshot may cover a prefix of the log; start
         # commit/applied there so replay resumes after it (lms.persistence
         # stores applied_index in its snapshot).
-        last_applied = min(last_applied, len(self.log))
+        if last_applied > self.last_log_index:
+            # Snapshot ahead of the WAL means log entries the snapshot
+            # already covers were lost/truncated. Silently rewinding would
+            # re-apply future committed entries ONTO snapshot state (double
+            # apply). Fail fast; the operator restores the matching WAL or
+            # wipes this node so it re-syncs from the leader.
+            raise RuntimeError(
+                f"state snapshot applied_index={last_applied} is ahead of "
+                f"the WAL (last index {self.last_log_index}): WAL lost or "
+                f"truncated; refusing to start to avoid re-applying "
+                f"committed entries onto snapshot state"
+            )
+        if last_applied < self.snapshot_index:
+            raise RuntimeError(
+                f"state snapshot applied_index={last_applied} predates the "
+                f"WAL's compaction point {self.snapshot_index}: entries "
+                f"{last_applied + 1}..{self.snapshot_index} are gone, the "
+                f"state can never catch up; restore a matching state "
+                f"snapshot or wipe this node"
+            )
         self.commit_index = last_applied
         self.last_applied = last_applied
+        # Follower side: a freshly installed snapshot the runner must hand
+        # to the application ((index, data) or None).
+        self.pending_snapshot: Optional[Tuple[int, bytes]] = None
         self.votes: Set[int] = set()
         self.next_index: Dict[int, int] = {}
         self.match_index: Dict[int, int] = {}
         self._last_heartbeat_sent = 0.0
+        # peer -> time the last InstallSnapshot was dispatched (throttle).
+        self._snapshot_sent_at: Dict[int, float] = {}
+        # Set while an installed snapshot awaits durable WAL replacement
+        # (ordering: the app persists its state snapshot FIRST, then the WAL
+        # compacts — see persist_installed_snapshot).
+        self._storage_install_pending = False
 
         # (peer_id, message) pairs for the runner to deliver.
         self.outbox: List[Tuple[int, object]] = []
@@ -111,16 +152,21 @@ class RaftCore:
 
     @property
     def last_log_index(self) -> int:
-        return len(self.log)
+        return self.snapshot_index + len(self.log)
 
     @property
     def last_log_term(self) -> int:
-        return self.log[-1].term if self.log else 0
+        return self.log[-1].term if self.log else self.snapshot_term
+
+    def entry_at(self, index: int) -> Entry:
+        return self.log[index - self.snapshot_index - 1]
 
     def entry_term(self, index: int) -> int:
         if index == 0:
             return 0
-        return self.log[index - 1].term
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        return self.entry_at(index).term
 
     def quorum(self) -> int:
         return (len(self.peer_ids) + 1) // 2 + 1
@@ -210,12 +256,40 @@ class RaftCore:
 
     # Append handling -----------------------------------------------------
 
-    def append_request_for(self, peer: int) -> AppendRequest:
-        """Build the next AppendEntries for `peer` from its next_index."""
+    def append_request_for(self, peer: int, now: Optional[float] = None):
+        """Build the next AppendEntries for `peer` from its next_index — or
+        an InstallSnapshot when the peer needs entries the log has compacted
+        away (Raft §7: the snapshot replaces the missing prefix). Returns
+        None when a snapshot to this peer is already in flight (payloads
+        are unbounded; re-sending one per heartbeat would multiply the
+        transfer dozens of times)."""
         nxt = self.next_index.get(peer, self.last_log_index + 1)
+        if nxt <= self.snapshot_index:
+            if self.snapshot_data is not None:
+                sent = self._snapshot_sent_at.get(peer)
+                if (
+                    now is not None
+                    and sent is not None
+                    and now - sent < self.config.snapshot_resend_interval
+                ):
+                    return None
+                if now is not None:
+                    self._snapshot_sent_at[peer] = now
+                return InstallSnapshotRequest(
+                    term=self.current_term,
+                    leader_id=self.node_id,
+                    last_included_index=self.snapshot_index,
+                    last_included_term=self.snapshot_term,
+                    data=self.snapshot_data,
+                )
+            # No snapshot bytes primed (shouldn't happen once the app calls
+            # compact() at boot): send from the compaction boundary; the
+            # peer will conflict until the app primes.
+            nxt = self.snapshot_index + 1
         prev = nxt - 1
+        off = prev - self.snapshot_index
         entries = tuple(
-            self.log[prev : prev + self.config.max_entries_per_append]
+            self.log[off : off + self.config.max_entries_per_append]
         )
         return AppendRequest(
             term=self.current_term,
@@ -229,7 +303,9 @@ class RaftCore:
     def broadcast_append(self, now: float) -> None:
         self._last_heartbeat_sent = now
         for peer in self.peer_ids:
-            self.outbox.append((peer, self.append_request_for(peer)))
+            msg = self.append_request_for(peer, now)
+            if msg is not None:
+                self.outbox.append((peer, msg))
 
     def on_append_request(self, req: AppendRequest, now: float) -> AppendResponse:
         if req.term > self.current_term:
@@ -249,15 +325,27 @@ class RaftCore:
                 success=False,
                 conflict_index=self.last_log_index + 1,
             )
+        if req.prev_log_index < self.snapshot_index:
+            # The request overlaps our snapshot-covered prefix (committed
+            # state we can no longer term-check entry by entry). Redirect
+            # the leader to resend from the compaction boundary.
+            return AppendResponse(
+                term=self.current_term,
+                success=False,
+                conflict_index=self.snapshot_index + 1,
+            )
         if (
-            req.prev_log_index > 0
+            req.prev_log_index > self.snapshot_index
             and self.entry_term(req.prev_log_index) != req.prev_log_term
         ):
             # Term conflict: find the first index of the conflicting term so
             # the leader can jump the whole term.
             bad_term = self.entry_term(req.prev_log_index)
             first = req.prev_log_index
-            while first > 1 and self.entry_term(first - 1) == bad_term:
+            while (
+                first > self.snapshot_index + 1
+                and self.entry_term(first - 1) == bad_term
+            ):
                 first -= 1
             return AppendResponse(
                 term=self.current_term, success=False, conflict_index=first
@@ -270,7 +358,7 @@ class RaftCore:
             index = req.prev_log_index + 1 + i
             if index <= self.last_log_index:
                 if self.entry_term(index) != entry.term:
-                    del self.log[index - 1 :]
+                    del self.log[index - self.snapshot_index - 1 :]
                     self.storage.truncate_from(index)
                 else:
                     continue
@@ -299,14 +387,18 @@ class RaftCore:
             # Keep streaming if the peer is still behind — otherwise catch-up
             # would be paced at max_entries_per_append per heartbeat.
             if self.next_index[peer] <= self.last_log_index:
-                self.outbox.append((peer, self.append_request_for(peer)))
+                msg = self.append_request_for(peer, now)
+                if msg is not None:
+                    self.outbox.append((peer, msg))
         else:
             if resp.conflict_index > 0:
                 self.next_index[peer] = max(1, resp.conflict_index)
             else:
                 self.next_index[peer] = max(1, self.next_index.get(peer, 1) - 1)
             # Retry immediately with the corrected window.
-            self.outbox.append((peer, self.append_request_for(peer)))
+            msg = self.append_request_for(peer, now)
+            if msg is not None:
+                self.outbox.append((peer, msg))
 
     def _advance_commit(self) -> None:
         """Majority-match advance, current-term entries only (Raft §5.4.2)."""
@@ -337,8 +429,102 @@ class RaftCore:
         out = []
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            out.append((self.last_applied, self.log[self.last_applied - 1]))
+            out.append((self.last_applied, self.entry_at(self.last_applied)))
         return out
+
+    # Snapshot / compaction ------------------------------------------------
+
+    def compact(self, index: int, data: bytes) -> None:
+        """Drop the log prefix <= `index`, now covered by the application
+        snapshot `data`. Called by the app after persisting its own state
+        snapshot at `index`; also primes the InstallSnapshot payload for
+        lagging peers. Never compacts past what this node has applied."""
+        if index > self.last_applied:
+            raise ValueError(
+                f"cannot compact to {index}: only applied {self.last_applied}"
+            )
+        if index <= self.snapshot_index:
+            if index == self.snapshot_index:
+                self.snapshot_data = data  # re-prime after restart
+            return
+        term = self.entry_term(index)
+        del self.log[: index - self.snapshot_index]
+        self.snapshot_index = index
+        self.snapshot_term = term
+        self.snapshot_data = data
+        self.storage.compact_to(index, term)
+
+    def on_install_snapshot(
+        self, req: InstallSnapshotRequest, now: float
+    ) -> InstallSnapshotResponse:
+        if req.term > self.current_term:
+            self._step_down(req.term, now)
+        if req.term < self.current_term:
+            return InstallSnapshotResponse(term=self.current_term, success=False)
+        if self.role is not Role.FOLLOWER:
+            self._step_down(req.term, now)
+        self.leader_id = req.leader_id
+        self._reset_election_timer(now)
+
+        if req.last_included_index <= self.last_applied:
+            # Already at/past this point; nothing to install.
+            return InstallSnapshotResponse(term=self.current_term, success=True)
+
+        if (
+            req.last_included_index <= self.last_log_index
+            and self.entry_term(req.last_included_index)
+            == req.last_included_term
+        ):
+            # Our log extends past the snapshot and agrees at its boundary:
+            # keep the suffix (Raft §7), just move the base forward.
+            del self.log[: req.last_included_index - self.snapshot_index]
+        else:
+            self.log = []
+        self.snapshot_index = req.last_included_index
+        self.snapshot_term = req.last_included_term
+        self.snapshot_data = req.data
+        self.commit_index = max(self.commit_index, req.last_included_index)
+        self.last_applied = req.last_included_index
+        # Durable ordering: the WAL must not compact before the application
+        # persists the state snapshot — a crash in between would leave a WAL
+        # whose base is ahead of the app state, which the boot check rejects
+        # as unrecoverable. The runner calls install_cb (app persists) and
+        # then persist_installed_snapshot(); both happen synchronously
+        # before the response leaves this node.
+        self._storage_install_pending = True
+        # The runner hands this to the application, which replaces its whole
+        # state (apply resumes from last_included_index + 1).
+        self.pending_snapshot = (req.last_included_index, req.data)
+        return InstallSnapshotResponse(term=self.current_term, success=True)
+
+    def persist_installed_snapshot(self) -> None:
+        """Durably replace the WAL with the installed snapshot base + suffix
+        (called by the runner AFTER the app persisted its state snapshot)."""
+        if self._storage_install_pending:
+            self.storage.install_snapshot(
+                self.snapshot_index, self.snapshot_term, self.log
+            )
+            self._storage_install_pending = False
+
+    def on_install_snapshot_response(
+        self,
+        peer: int,
+        sent: InstallSnapshotRequest,
+        resp: InstallSnapshotResponse,
+        now: float,
+    ) -> None:
+        if resp.term > self.current_term:
+            self._step_down(resp.term, now)
+            return
+        if self.role is not Role.LEADER or resp.term != self.current_term:
+            return
+        if resp.success:
+            if sent.last_included_index > self.match_index.get(peer, 0):
+                self.match_index[peer] = sent.last_included_index
+            self.next_index[peer] = self.match_index[peer] + 1
+            self._advance_commit()
+            if self.next_index[peer] <= self.last_log_index:
+                self.outbox.append((peer, self.append_request_for(peer)))
 
     def drain_outbox(self) -> List[Tuple[int, object]]:
         out, self.outbox = self.outbox, []

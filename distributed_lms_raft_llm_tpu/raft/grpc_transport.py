@@ -31,6 +31,8 @@ from .messages import (
     AppendRequest,
     AppendResponse,
     Entry,
+    InstallSnapshotRequest,
+    InstallSnapshotResponse,
     VoteRequest,
     VoteResponse,
 )
@@ -98,6 +100,30 @@ def append_response_to_wire(resp: AppendResponse) -> lms_pb2.AppendEntriesRespon
     )
 
 
+def install_request_to_wire(
+    req: InstallSnapshotRequest,
+) -> lms_pb2.InstallSnapshotRequest:
+    return lms_pb2.InstallSnapshotRequest(
+        term=req.term,
+        leaderID=req.leader_id,
+        lastIncludedIndex=req.last_included_index,
+        lastIncludedTerm=req.last_included_term,
+        data=req.data,
+    )
+
+
+def install_request_from_wire(
+    msg: lms_pb2.InstallSnapshotRequest,
+) -> InstallSnapshotRequest:
+    return InstallSnapshotRequest(
+        term=msg.term,
+        leader_id=msg.leaderID,
+        last_included_index=msg.lastIncludedIndex,
+        last_included_term=msg.lastIncludedTerm,
+        data=msg.data,
+    )
+
+
 # -------------------------------- transport --------------------------------
 
 
@@ -137,6 +163,11 @@ class GrpcTransport(Transport):
                 ),
                 conflict_index=0,  # wire carries no hint: core decrements
             )
+        if isinstance(message, InstallSnapshotRequest):
+            wire = await stub.InstallSnapshot(
+                install_request_to_wire(message), timeout=self.rpc_timeout
+            )
+            return InstallSnapshotResponse(term=wire.term, success=wire.success)
         raise TypeError(type(message))
 
     async def close(self) -> None:
@@ -167,6 +198,14 @@ class RaftServicer(rpc.RaftServiceServicer):
     async def AppendEntries(self, request, context):
         resp = self.node.handle_append_request(append_request_from_wire(request))
         return append_response_to_wire(resp)
+
+    async def InstallSnapshot(self, request, context):
+        resp = self.node.handle_install_snapshot(
+            install_request_from_wire(request)
+        )
+        return lms_pb2.InstallSnapshotResponse(
+            term=resp.term, success=resp.success
+        )
 
     async def WhoIsLeader(self, request, context):
         leader = self.node.leader_id

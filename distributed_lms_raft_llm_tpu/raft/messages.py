@@ -60,6 +60,25 @@ class AppendResponse:
     conflict_index: int = 0
 
 
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotRequest:
+    """Leader → lagging follower: state-machine snapshot replacing the log
+    prefix the leader has compacted away (Raft §7). `data` is the
+    application snapshot (JSON state dict bytes for the LMS)."""
+
+    term: int
+    leader_id: int
+    last_included_index: int
+    last_included_term: int
+    data: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotResponse:
+    term: int
+    success: bool
+
+
 def encode_command(op: str, args: Optional[Dict[str, Any]] = None) -> str:
     return json.dumps({"op": op, "args": args or {}}, sort_keys=True)
 

@@ -30,6 +30,8 @@ from .messages import (
     AppendRequest,
     AppendResponse,
     Entry,
+    InstallSnapshotRequest,
+    InstallSnapshotResponse,
     VoteRequest,
     VoteResponse,
 )
@@ -37,6 +39,8 @@ from .messages import (
 log = logging.getLogger(__name__)
 
 ApplyCallback = Callable[[int, Entry], None]
+# (last_included_index, snapshot_bytes) -> None: replace the app state.
+InstallCallback = Callable[[int, bytes], None]
 
 
 class Transport:
@@ -59,6 +63,7 @@ class RaftNode:
         apply_cb: Optional[ApplyCallback] = None,
         config: Optional[RaftConfig] = None,
         *,
+        install_cb: Optional[InstallCallback] = None,
         tick_interval: float = 0.01,
         seed: Optional[int] = None,
         last_applied: int = 0,
@@ -69,6 +74,7 @@ class RaftNode:
         )
         self.transport = transport
         self.apply_cb = apply_cb
+        self.install_cb = install_cb
         self.tick_interval = tick_interval
         # index -> [(expected_term, future)]: a waiter only resolves if the
         # entry committed at its index carries the term it was proposed in —
@@ -135,6 +141,19 @@ class RaftNode:
         self._pump()
         return resp
 
+    def handle_install_snapshot(
+        self, req: InstallSnapshotRequest
+    ) -> InstallSnapshotResponse:
+        resp = self.core.on_install_snapshot(req, time.monotonic())
+        self._pump()
+        return resp
+
+    def compact(self, index: int, snapshot_data: bytes) -> None:
+        """App-driven log compaction: the state snapshot at `index` is
+        durable, so the WAL prefix through `index` can go (and `data` serves
+        lagging peers via InstallSnapshot)."""
+        self.core.compact(index, snapshot_data)
+
     # ------------------------------------------------------------ internals
 
     async def _tick_loop(self) -> None:
@@ -145,6 +164,15 @@ class RaftNode:
 
     def _pump(self) -> None:
         """Apply newly committed entries and dispatch outbound messages."""
+        if self.core.pending_snapshot is not None:
+            index, data = self.core.pending_snapshot
+            self.core.pending_snapshot = None
+            if self.install_cb is not None:
+                try:
+                    self.install_cb(index, data)
+                except Exception:
+                    log.exception("snapshot install callback failed at %d",
+                                  index)
         for index, entry in self.core.take_applies():
             self._resolve_waiters(index, entry)
             if self.apply_cb is not None and entry.command != NOOP:
@@ -170,6 +198,10 @@ class RaftNode:
             self.core.on_vote_response(peer, resp, now)
         elif isinstance(message, AppendRequest) and isinstance(resp, AppendResponse):
             self.core.on_append_response(peer, resp, now)
+        elif isinstance(message, InstallSnapshotRequest) and isinstance(
+            resp, InstallSnapshotResponse
+        ):
+            self.core.on_install_snapshot_response(peer, message, resp, now)
         self._pump()
 
     def _discard_task(self, task: asyncio.Task) -> None:
@@ -253,6 +285,8 @@ class MemNetwork:
             resp = node.handle_vote_request(message)
         elif isinstance(message, AppendRequest):
             resp = node.handle_append_request(message)
+        elif isinstance(message, InstallSnapshotRequest):
+            resp = node.handle_install_snapshot(message)
         else:
             raise TypeError(type(message))
         if self._blocked(dst, src):

@@ -1,4 +1,4 @@
-"""Durable Raft state: current_term, voted_for, and the log.
+"""Durable Raft state: current_term, voted_for, the log, and its snapshot base.
 
 The reference keeps all Raft state in process memory — a restarted node
 rejoins at term 0 with an empty log, violating Raft's durability assumptions
@@ -6,12 +6,20 @@ rejoins at term 0 with an empty log, violating Raft's durability assumptions
 to a JSONL write-ahead file before the core sends any message that depends
 on it; recovery replays the file.
 
+The log is compactable (Raft §7): once the application has snapshotted its
+state at index S, the WAL prefix 1..S is dropped and replaced by a `snap`
+record carrying (S, term-at-S). Entry indices are ABSOLUTE throughout — the
+in-memory list holds entries S+1..last, and `snapshot_index` anchors the
+offset. The reference kept every entry forever (it persisted nothing).
+
 Records:
     {"t": "meta", "term": N, "voted_for": id|null}
     {"t": "entry", "i": index, "term": N, "cmd": "..."}
     {"t": "trunc", "i": index}          # delete entries >= index
+    {"t": "snap", "i": index, "term": N}  # prefix <= index now snapshot-covered
 
-Compaction rewrites the file from live state when it grows past a bound.
+Compaction rewrites the file from live state (snap record + surviving
+suffix) when it grows past a bound or when `compact_to` is called.
 `MemoryStorage` backs deterministic tests and simulated restarts.
 """
 
@@ -24,6 +32,9 @@ from typing import List, Optional, Sequence, Tuple
 
 from .messages import Entry
 
+# (term, voted_for, entries, snapshot_index, snapshot_term)
+LoadResult = Tuple[int, Optional[int], List[Entry], int, int]
+
 
 class MemoryStorage:
     """In-memory storage; survives simulated 'restarts' of a RaftCore by
@@ -33,24 +44,43 @@ class MemoryStorage:
         self.term = 0
         self.voted_for: Optional[int] = None
         self.entries: List[Entry] = []
+        self.snapshot_index = 0
+        self.snapshot_term = 0
 
-    def load(self) -> Tuple[int, Optional[int], List[Entry]]:
-        return self.term, self.voted_for, list(self.entries)
+    def load(self) -> LoadResult:
+        return (self.term, self.voted_for, list(self.entries),
+                self.snapshot_index, self.snapshot_term)
 
     def save_meta(self, term: int, voted_for: Optional[int]) -> None:
         self.term = term
         self.voted_for = voted_for
 
     def append_entries(self, first_index: int, entries: Sequence[Entry]) -> None:
-        assert first_index == len(self.entries) + 1, (first_index, len(self.entries))
+        expected = self.snapshot_index + len(self.entries) + 1
+        assert first_index == expected, (first_index, expected)
         self.entries.extend(entries)
 
     def truncate_from(self, index: int) -> None:
-        del self.entries[index - 1 :]
+        del self.entries[index - self.snapshot_index - 1:]
+
+    def compact_to(self, index: int, term: int) -> None:
+        """Drop entries <= index (now covered by the app snapshot)."""
+        if index <= self.snapshot_index:
+            return
+        del self.entries[: index - self.snapshot_index]
+        self.snapshot_index = index
+        self.snapshot_term = term
+
+    def install_snapshot(self, index: int, term: int,
+                         remaining: Sequence[Entry]) -> None:
+        """Follower side: replace the whole log with snapshot base + suffix."""
+        self.snapshot_index = index
+        self.snapshot_term = term
+        self.entries = list(remaining)
 
 
 class FileStorage:
-    """JSONL WAL with periodic compaction."""
+    """JSONL WAL with snapshot-aware compaction."""
 
     def __init__(self, path: str, *, fsync: bool = True,
                  compact_every_bytes: int = 4 * 1024 * 1024):
@@ -60,6 +90,8 @@ class FileStorage:
         self._term = 0
         self._voted_for: Optional[int] = None
         self._entries: List[Entry] = []
+        self._snapshot_index = 0
+        self._snapshot_term = 0
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._replay()
         self._fh = open(self.path, "a", encoding="utf-8")
@@ -84,12 +116,20 @@ class FileStorage:
                         self._voted_for = rec["voted_for"]
                     elif kind == "entry":
                         idx = rec["i"]
-                        if idx == len(self._entries) + 1:
+                        if idx == self._snapshot_index + len(self._entries) + 1:
                             self._entries.append(
                                 Entry(term=rec["term"], command=rec["cmd"])
                             )
                     elif kind == "trunc":
-                        del self._entries[rec["i"] - 1 :]
+                        del self._entries[rec["i"] - self._snapshot_index - 1:]
+                    elif kind == "snap":
+                        idx = rec["i"]
+                        if idx > self._snapshot_index:
+                            drop = min(idx - self._snapshot_index,
+                                       len(self._entries))
+                            del self._entries[:drop]
+                            self._snapshot_index = idx
+                            self._snapshot_term = rec["term"]
                 good_offset += len(raw)
         # Drop any torn tail so the next append starts on a clean line —
         # otherwise the new record merges into the partial one and the
@@ -100,8 +140,9 @@ class FileStorage:
 
     # ----------------------------------------------------------------- api
 
-    def load(self) -> Tuple[int, Optional[int], List[Entry]]:
-        return self._term, self._voted_for, list(self._entries)
+    def load(self) -> LoadResult:
+        return (self._term, self._voted_for, list(self._entries),
+                self._snapshot_index, self._snapshot_term)
 
     def _write(self, rec: dict) -> None:
         self._fh.write(json.dumps(rec) + "\n")
@@ -119,23 +160,46 @@ class FileStorage:
     def append_entries(self, first_index: int, entries: Sequence[Entry]) -> None:
         for i, e in enumerate(entries):
             idx = first_index + i
-            assert idx == len(self._entries) + 1
+            assert idx == self._snapshot_index + len(self._entries) + 1
             self._entries.append(e)
             self._write({"t": "entry", "i": idx, "term": e.term, "cmd": e.command})
 
     def truncate_from(self, index: int) -> None:
-        del self._entries[index - 1 :]
+        del self._entries[index - self._snapshot_index - 1:]
         self._write({"t": "trunc", "i": index})
 
+    def compact_to(self, index: int, term: int) -> None:
+        """Drop the WAL prefix <= index (the app snapshot now covers it) and
+        rewrite the file so the disk footprint actually shrinks."""
+        if index <= self._snapshot_index:
+            return
+        del self._entries[: index - self._snapshot_index]
+        self._snapshot_index = index
+        self._snapshot_term = term
+        self._compact()
+
+    def install_snapshot(self, index: int, term: int,
+                         remaining: Sequence[Entry]) -> None:
+        self._snapshot_index = index
+        self._snapshot_term = term
+        self._entries = list(remaining)
+        self._compact()
+
     def _compact(self) -> None:
-        """Rewrite the WAL as one meta record + live entries, atomically."""
+        """Rewrite the WAL as meta + snap + live entries, atomically."""
         dir_ = os.path.dirname(os.path.abspath(self.path))
         fd, tmp = tempfile.mkstemp(dir=dir_, prefix=".raftwal.")
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             f.write(json.dumps(
                 {"t": "meta", "term": self._term, "voted_for": self._voted_for}
             ) + "\n")
-            for i, e in enumerate(self._entries, start=1):
+            if self._snapshot_index:
+                f.write(json.dumps(
+                    {"t": "snap", "i": self._snapshot_index,
+                     "term": self._snapshot_term}
+                ) + "\n")
+            for i, e in enumerate(self._entries,
+                                  start=self._snapshot_index + 1):
                 f.write(json.dumps(
                     {"t": "entry", "i": i, "term": e.term, "cmd": e.command}
                 ) + "\n")

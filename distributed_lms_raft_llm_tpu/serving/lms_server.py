@@ -66,6 +66,11 @@ async def serve_async(args) -> None:
         )
         gate.warmup()
 
+    tutoring_auth_key = None
+    if args.tutoring_auth_key_file:
+        with open(args.tutoring_auth_key_file) as fh:
+            tutoring_auth_key = fh.read().strip()
+
     metrics = Metrics()
     servicer = LMSServicer(
         lms_node.node,
@@ -73,7 +78,10 @@ async def serve_async(args) -> None:
         lms_node.blobs,
         gate=gate,
         tutoring_address=args.tutoring,
+        tutoring_auth_key=tutoring_auth_key,
         metrics=metrics,
+        peer_addresses=addresses,
+        self_id=args.id,
     )
     server = grpc.aio.server(
         options=[
@@ -119,6 +127,9 @@ def main(argv=None) -> None:
                         help="state directory (default ./lms_node_<id>)")
     parser.add_argument("--tutoring", default=None,
                         help="tutoring server address (host:port)")
+    parser.add_argument("--tutoring-auth-key-file", default=None,
+                        help="file holding the LMS↔tutoring shared secret "
+                        "(must match the tutoring server's --auth-key-file)")
     parser.add_argument("--gate-model", default=None,
                         help="BERT gate model preset ('bert-base-uncased' or "
                              "'tiny'); omit to disable the gate")

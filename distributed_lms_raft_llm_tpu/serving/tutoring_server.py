@@ -35,6 +35,7 @@ from ..engine import (
     TutoringEngine,
 )
 from ..proto import lms_pb2, rpc
+from ..utils import auth
 from ..utils.metrics import Metrics
 
 log = logging.getLogger("tutoring_server")
@@ -48,12 +49,25 @@ PROMPT_TEMPLATE = (
 
 
 class TutoringService(rpc.TutoringServicer):
-    def __init__(self, queue: BatchingQueue, metrics: Metrics):
+    def __init__(self, queue: BatchingQueue, metrics: Metrics,
+                 auth_key: Optional[str] = None):
         self.queue = queue
         self.metrics = metrics
+        self.auth_key = auth_key
 
     async def GetLLMAnswer(self, request, context):
         self.metrics.inc("llm_requests")
+        if self.auth_key and not auth.verify_query(
+            self.auth_key, request.query, request.token
+        ):
+            # Only the LMS leader holds the key: direct dials can't bypass
+            # the session check and BERT gate (reference defect: token was
+            # never read, tutoring_server.py:33-37).
+            self.metrics.inc("llm_unauthorized")
+            return lms_pb2.QueryResponse(
+                success=False, response="Unauthorized: query the LMS, not "
+                "the tutoring node."
+            )
         if not request.query.strip():
             return lms_pb2.QueryResponse(success=False, response="Empty query.")
         prompt = PROMPT_TEMPLATE.format(query=request.query)
@@ -85,6 +99,7 @@ async def serve_async(
     max_wait_ms: float = 10.0,
     metrics: Optional[Metrics] = None,
     metrics_period_s: float = 60.0,
+    auth_key: Optional[str] = None,
 ) -> grpc.aio.Server:
     """Start (and return) the aio server; caller awaits termination.
 
@@ -105,7 +120,9 @@ async def serve_async(
             ("grpc.max_receive_message_length", 50 * 1024 * 1024),
         ]
     )
-    rpc.add_TutoringServicer_to_server(TutoringService(queue, metrics), server)
+    rpc.add_TutoringServicer_to_server(
+        TutoringService(queue, metrics, auth_key=auth_key), server
+    )
     server.add_insecure_port(f"[::]:{port}")
     await server.start()
     # Keep strong references (asyncio tasks are weakly held by the loop) and
@@ -141,6 +158,11 @@ def main(argv=None) -> None:
                         "bucket)")
     parser.add_argument("--no-warmup", action="store_true")
     parser.add_argument(
+        "--auth-key-file", default=None,
+        help="file holding the LMS↔tutoring shared secret; when set, only "
+        "queries HMAC-signed by the LMS leader are answered",
+    )
+    parser.add_argument(
         "--jax-platform", default="default", choices=["cpu", "default"],
         help="'cpu' for CPU-only runs (tests/dev); default uses the TPU",
     )
@@ -174,10 +196,15 @@ def main(argv=None) -> None:
                 else engine.warmup(batch=args.max_batch))
         log.info("warmup compile took %.1fs", secs)
 
+    auth_key = None
+    if args.auth_key_file:
+        with open(args.auth_key_file) as fh:
+            auth_key = fh.read().strip()
+
     async def run():
         server = await serve_async(
             args.port, engine, max_batch=args.max_batch,
-            max_wait_ms=args.max_wait_ms,
+            max_wait_ms=args.max_wait_ms, auth_key=auth_key,
         )
         await server.wait_for_termination()
 

@@ -1,0 +1,51 @@
+"""Forwarding auth for the tutoring port.
+
+The reference's tutoring server answers anyone who reaches the port —
+`request.token` is never read (reference: GUI_RAFT_LLM_SourceCode/
+tutoring_server.py:33-37), so the LMS session check and the BERT relevance
+gate can be bypassed by dialing the tutoring node directly.
+
+Fix: the LMS leader and the tutoring node share a secret; the leader stamps
+each forwarded query with an HMAC ticket carried in the existing
+`QueryRequest.token` field (the wire contract is unchanged — the field is a
+string either way). The tutoring node only answers queries whose ticket
+verifies. Clients never see the secret; the student's session token is
+validated on the LMS before forwarding, exactly as before.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+
+# Tickets expire: traffic is plaintext gRPC, so an observed ticket must not
+# grant indefinite replay access to the tutoring port. 60 s comfortably
+# covers leader→tutoring forwarding latency.
+TICKET_TTL_S = 60
+
+
+def _mac(key: str, expires_at: int, query: str) -> str:
+    msg = f"{expires_at}|{query}".encode()
+    return hmac.new(key.encode(), msg, hashlib.sha256).hexdigest()
+
+
+def sign_query(key: str, query: str, now: float | None = None) -> str:
+    """Expiring ticket the LMS leader attaches to a gate-approved query.
+
+    Format "<unix-expiry>:<hmac-sha256 of 'expiry|query'>" — the expiry is
+    authenticated, so it can't be extended by the bearer.
+    """
+    expires_at = int(now if now is not None else time.time()) + TICKET_TTL_S
+    return f"{expires_at}:{_mac(key, expires_at, query)}"
+
+
+def verify_query(key: str, query: str, ticket: str,
+                 now: float | None = None) -> bool:
+    expiry_s, sep, mac = (ticket or "").partition(":")
+    if not sep or not expiry_s.isdigit():
+        return False
+    expires_at = int(expiry_s)
+    if (now if now is not None else time.time()) >= expires_at:
+        return False
+    return hmac.compare_digest(_mac(key, expires_at, query), mac)

@@ -1,0 +1,237 @@
+"""Tests for the advisor-flagged correctness/security fixes:
+
+1. tutoring port rejects unsigned queries when an auth key is configured;
+2. a node serving committed metadata fetches a missing blob from a peer
+   instead of returning empty bytes;
+3. a retried mutation carrying the same request_id applies exactly once;
+4. a Raft node whose snapshot is ahead of its WAL fails fast instead of
+   silently re-applying committed entries onto snapshot state;
+5. passwords are salted-KDF hashed, salt carried in the replicated command.
+"""
+
+import asyncio
+
+import grpc
+import pytest
+
+from distributed_lms_raft_llm_tpu.lms.persistence import BlobStore
+from distributed_lms_raft_llm_tpu.lms.service import (
+    FileTransferServicer,
+    LMSServicer,
+)
+from distributed_lms_raft_llm_tpu.lms.state import LMSState, hash_password
+from distributed_lms_raft_llm_tpu.proto import lms_pb2, rpc
+from distributed_lms_raft_llm_tpu.raft.core import RaftCore
+from distributed_lms_raft_llm_tpu.serving.tutoring_server import TutoringService
+from distributed_lms_raft_llm_tpu.utils import auth
+from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+
+# ------------------------------------------------------- 1. tutoring auth
+
+
+class _EchoQueue:
+    async def submit(self, prompt: str) -> str:
+        return "the tutor's answer"
+
+
+def test_tutoring_rejects_unsigned_queries():
+    svc = TutoringService(_EchoQueue(), Metrics(), auth_key="secret-key")
+
+    async def run():
+        bogus = await svc.GetLLMAnswer(
+            lms_pb2.QueryRequest(token="some-session-token", query="q"), None
+        )
+        assert not bogus.success
+        assert "Unauthorized" in bogus.response
+
+        signed = await svc.GetLLMAnswer(
+            lms_pb2.QueryRequest(
+                token=auth.sign_query("secret-key", "q"), query="q"
+            ),
+            None,
+        )
+        assert signed.success
+        assert signed.response == "the tutor's answer"
+
+        # Ticket is bound to the query text: replaying it for another
+        # query fails.
+        replay = await svc.GetLLMAnswer(
+            lms_pb2.QueryRequest(
+                token=auth.sign_query("secret-key", "q"), query="other"
+            ),
+            None,
+        )
+        assert not replay.success
+
+        # Tickets expire: an observed one can't be replayed forever.
+        stale = auth.sign_query(
+            "secret-key", "q", now=1000.0 - auth.TICKET_TTL_S - 1
+        )
+        old = await svc.GetLLMAnswer(
+            lms_pb2.QueryRequest(token=stale, query="q"), None
+        )
+        assert not old.success
+
+    asyncio.run(run())
+
+
+def test_ticket_expiry_is_authenticated():
+    good = auth.sign_query("k", "q", now=1000.0)
+    assert auth.verify_query("k", "q", good, now=1000.0)
+    assert not auth.verify_query("k", "q", good, now=1000.0 + auth.TICKET_TTL_S)
+    # Bearer can't extend the expiry: it is inside the MAC.
+    expiry, _, mac = good.partition(":")
+    forged = f"{int(expiry) + 9999}:{mac}"
+    assert not auth.verify_query("k", "q", forged, now=1000.0)
+    assert not auth.verify_query("k", "q", "garbage", now=1000.0)
+    assert not auth.verify_query("k", "q", "", now=1000.0)
+
+
+def test_tutoring_without_key_keeps_reference_behavior():
+    svc = TutoringService(_EchoQueue(), Metrics(), auth_key=None)
+
+    async def run():
+        resp = await svc.GetLLMAnswer(
+            lms_pb2.QueryRequest(token="anything", query="q"), None
+        )
+        assert resp.success
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------- 2. blob fetch-on-miss
+
+
+class _FakeNode:
+    leader_id = 1
+    is_leader = False
+
+
+def test_blob_fetch_on_miss_heals_from_peer(tmp_path):
+    src = BlobStore(str(tmp_path / "peer"))
+    src.put("materials/notes.pdf", b"%PDF real content")
+
+    async def run():
+        server = grpc.aio.server()
+        rpc.add_FileTransferServiceServicer_to_server(
+            FileTransferServicer(src), server
+        )
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        try:
+            local = BlobStore(str(tmp_path / "local"))
+            svc = LMSServicer(
+                _FakeNode(),
+                LMSState(),
+                local,
+                peer_addresses={1: f"127.0.0.1:{port}"},
+                self_id=2,
+            )
+            content = await svc._blob("materials/notes.pdf")
+            assert content == b"%PDF real content"
+            # The miss healed permanently: the blob is now local.
+            assert local.get("materials/notes.pdf") == b"%PDF real content"
+            # A blob nobody has comes back empty (logged, not fatal) and is
+            # negative-cached so the next read skips the peer sweep.
+            assert await svc._blob("materials/ghost.pdf") == b""
+            assert svc._blob_missing.get("materials/ghost.pdf", 0) > 0
+            assert await svc._blob("materials/ghost.pdf") == b""
+            # A traversal path from a hostile peer is found=False, not an
+            # unhandled server error.
+            ch = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            stub = rpc.FileTransferServiceStub(ch)
+            resp = await stub.FetchFile(
+                lms_pb2.FetchFileRequest(path="../../etc/passwd"), timeout=5
+            )
+            assert not resp.found
+            await ch.close()
+        finally:
+            await server.stop(None)
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------- 3. request-id dedup
+
+
+def test_duplicate_request_id_applies_once():
+    state = LMSState()
+    args = {"username": "amy", "query": "what is raft?", "request_id": "r1"}
+    state.apply("AskQuery", dict(args))
+    state.apply("AskQuery", dict(args))  # client retry, same id
+    assert len(state.data["queries"]["amy"]) == 1
+    # A different id is a genuinely new mutation.
+    state.apply(
+        "AskQuery",
+        {"username": "amy", "query": "what is raft?", "request_id": "r2"},
+    )
+    assert len(state.data["queries"]["amy"]) == 2
+    # Commands without an id (old clients) are never deduplicated.
+    state.apply("AskQuery", {"username": "amy", "query": "q"})
+    state.apply("AskQuery", {"username": "amy", "query": "q"})
+    assert len(state.data["queries"]["amy"]) == 4
+
+
+def test_request_ledger_survives_snapshot_roundtrip():
+    import json
+
+    state = LMSState()
+    state.apply("AskQuery", {"username": "a", "query": "q", "request_id": "x"})
+    restored = LMSState(json.loads(json.dumps(state.data)))
+    restored.apply("AskQuery", {"username": "a", "query": "q", "request_id": "x"})
+    assert len(restored.data["queries"]["a"]) == 1
+
+
+# --------------------------------------------- 4. snapshot-ahead-of-WAL
+
+
+class _EmptyStorage:
+    def load(self):
+        # term 3, no vote, EMPTY log, no compaction (lost/truncated WAL)
+        return 3, None, [], 0, 0
+
+
+def test_snapshot_ahead_of_wal_fails_fast():
+    with pytest.raises(RuntimeError, match="ahead of the WAL"):
+        RaftCore(1, [1, 2, 3], _EmptyStorage(), last_applied=5)
+
+
+# ----------------------------------------------------- 5. salted KDF
+
+
+def test_passwords_salted_and_replicated_deterministically():
+    state = LMSState()
+    state.apply(
+        "Register",
+        {
+            "username": "amy",
+            "password_hash": hash_password("pw", "ab" * 16),
+            "salt": "ab" * 16,
+            "role": "student",
+        },
+    )
+    state.apply(
+        "Register",
+        {
+            "username": "bob",
+            "password_hash": hash_password("pw", "cd" * 16),
+            "salt": "cd" * 16,
+            "role": "student",
+        },
+    )
+    # Same password, different salts -> different stored hashes.
+    assert (
+        state.data["users"]["amy"]["password"]
+        != state.data["users"]["bob"]["password"]
+    )
+    assert state.check_password("amy", "pw")
+    assert not state.check_password("amy", "wrong")
+
+    # Legacy states (pre-salt) still authenticate.
+    legacy = LMSState()
+    legacy.data["users"]["old"] = {
+        "password": hash_password("pw"),
+        "role": "student",
+    }
+    assert legacy.check_password("old", "pw")

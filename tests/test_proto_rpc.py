@@ -48,12 +48,22 @@ def test_frozen_contract_method_names():
         ]
     )
     assert [m.name for m in services["Tutoring"].methods] == ["GetLLMAnswer"]
-    assert sorted(m.name for m in services["RaftService"].methods) == sorted(
-        ["RequestVote", "AppendEntries", "SetVal", "GetVal", "GetLeader", "WhoIsLeader"]
-    )
-    assert sorted(m.name for m in services["FileTransferService"].methods) == sorted(
-        ["SendFile", "ReplicateData"]
-    )
+    # Frozen = the reference surface never shrinks or renames; additive
+    # methods (which old peers simply never call) are the sanctioned
+    # extension mechanism. Assert superset + name the additions exactly, so
+    # an accidental addition still fails here.
+    raft_methods = {m.name for m in services["RaftService"].methods}
+    assert raft_methods >= {
+        "RequestVote", "AppendEntries", "SetVal", "GetVal", "GetLeader",
+        "WhoIsLeader",
+    }
+    assert raft_methods - {
+        "RequestVote", "AppendEntries", "SetVal", "GetVal", "GetLeader",
+        "WhoIsLeader",
+    } == {"InstallSnapshot"}
+    ft_methods = {m.name for m in services["FileTransferService"].methods}
+    assert ft_methods >= {"SendFile", "ReplicateData"}
+    assert ft_methods - {"SendFile", "ReplicateData"} == {"FetchFile"}
     # Stream-unary only for SendFile.
     assert services["FileTransferService"].methods_by_name["SendFile"].client_streaming
     assert rpc._SERVICES["FileTransferService"]["SendFile"][2] == "su"

@@ -35,7 +35,7 @@ def test_file_storage_roundtrip_and_truncate(tmp_path):
     s.close()
 
     s2 = FileStorage(path, fsync=False)
-    term, voted, entries = s2.load()
+    term, voted, entries, _, _ = s2.load()
     assert (term, voted) == (3, 2)
     assert [(e.term, e.command) for e in entries] == [(1, "a"), (3, "d")]
     s2.close()
@@ -50,7 +50,7 @@ def test_file_storage_survives_torn_tail(tmp_path):
     with open(path, "a") as f:
         f.write('{"t": "entry", "i": 2, "ter')  # crash mid-write
     s2 = FileStorage(path, fsync=False)
-    term, voted, entries = s2.load()
+    term, voted, entries, _, _ = s2.load()
     assert term == 1 and len(entries) == 1
     # Records written after the torn tail must survive the NEXT restart too
     # (the torn line is truncated, not appended onto).
@@ -58,7 +58,7 @@ def test_file_storage_survives_torn_tail(tmp_path):
     s2.append_entries(2, [Entry(7, "b")])
     s2.close()
     s3 = FileStorage(path, fsync=False)
-    term, voted, entries = s3.load()
+    term, voted, entries, _, _ = s3.load()
     assert (term, voted) == (7, 3)
     assert [e.command for e in entries] == ["a", "b"]
     s3.close()
@@ -72,7 +72,7 @@ def test_file_storage_compaction(tmp_path):
     size = os.path.getsize(path)
     assert size < 20000  # compaction kept it bounded
     s2 = FileStorage(path, fsync=False)
-    _, _, entries = s2.load()
+    _, _, entries, _, _ = s2.load()
     assert len(entries) == 59
     s.close()
     s2.close()
