@@ -524,7 +524,9 @@ class RaftCore:
             self.next_index[peer] = self.match_index[peer] + 1
             self._advance_commit()
             if self.next_index[peer] <= self.last_log_index:
-                self.outbox.append((peer, self.append_request_for(peer)))
+                msg = self.append_request_for(peer, now)
+                if msg is not None:
+                    self.outbox.append((peer, msg))
 
     def drain_outbox(self) -> List[Tuple[int, object]]:
         out, self.outbox = self.outbox, []

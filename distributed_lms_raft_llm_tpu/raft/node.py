@@ -171,8 +171,18 @@ class RaftNode:
                 try:
                     self.install_cb(index, data)
                 except Exception:
+                    # Fail fast: raft state already advanced to the snapshot
+                    # point; proceeding with an app that never installed it
+                    # would silently diverge (same contract as the boot
+                    # checks in lms/node.py).
                     log.exception("snapshot install callback failed at %d",
                                   index)
+                    raise
+            # Durable ordering (core.on_install_snapshot docstring): the app
+            # has persisted its state snapshot, so the WAL may now be
+            # replaced with the new base + suffix — before the RPC response
+            # leaves this node.
+            self.core.persist_installed_snapshot()
         for index, entry in self.core.take_applies():
             self._resolve_waiters(index, entry)
             if self.apply_cb is not None and entry.command != NOOP:

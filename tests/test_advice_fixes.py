@@ -235,3 +235,17 @@ def test_passwords_salted_and_replicated_deterministically():
         "role": "student",
     }
     assert legacy.check_password("old", "pw")
+
+
+def test_failed_handler_does_not_poison_request_ledger():
+    """A handler exception must leave the request_id unrecorded so a client
+    retry is re-attempted, not silently dropped (ADVICE r3 #4)."""
+    state = LMSState()
+    args = {"username": "amy", "query": "q", "request_id": "boom"}
+    with pytest.raises(ValueError):
+        state.apply("NoSuchCommand", dict(args))
+    assert "boom" not in state.data.get("applied_requests", {})
+    # The retry with the same id goes through once the command is valid.
+    state.apply("AskQuery", dict(args))
+    assert len(state.data["queries"]["amy"]) == 1
+    assert "boom" in state.data["applied_requests"]

@@ -143,3 +143,35 @@ def test_wiped_follower_converges_via_install_snapshot(tmp_path):
                 await s.stop(None)
 
     asyncio.run(run())
+
+
+def test_install_callback_failure_fails_fast():
+    """If the app cannot persist an installed snapshot, the node must not
+    keep serving with raft state claiming an apply point the application
+    never reached (ADVICE r3 #2): the RPC handler raises instead of
+    silently proceeding, and the WAL keeps its old base."""
+    from distributed_lms_raft_llm_tpu.raft.messages import (
+        InstallSnapshotRequest,
+    )
+    from distributed_lms_raft_llm_tpu.raft.node import RaftNode, Transport
+    from distributed_lms_raft_llm_tpu.raft.storage import MemoryStorage
+
+    def bad_install(index, data):
+        raise IOError("disk full")
+
+    storage = MemoryStorage()
+    node = RaftNode(2, [1, 2, 3], storage, Transport(),
+                    config=FAST, install_cb=bad_install)
+    req = InstallSnapshotRequest(
+        term=1, leader_id=1, last_included_index=5, last_included_term=1,
+        data=b"{}",
+    )
+    try:
+        node.handle_install_snapshot(req)
+        raised = False
+    except IOError:
+        raised = True
+    assert raised, "install failure must propagate, not be swallowed"
+    # Durable storage never compacted to the uninstalled base.
+    _, _, _, snap_idx, _ = storage.load()
+    assert snap_idx == 0
