@@ -1,0 +1,436 @@
+"""Headless GUI tests: drive every LMSApp screen through fake Tk widgets.
+
+There is no display in CI, so `client.gui` is written to touch the toolkit
+only via its module attributes (`gui.tk`, `gui.messagebox`,
+`gui.filedialog`); these tests substitute a minimal widget fake that
+records the tree, lets tests click buttons / fill entries / select list
+rows, and asserts the RPCs the screens issue against a scripted client.
+
+Covers the reference screen inventory (SURVEY.md C11) including the D8
+regression: downloading saves the *selected* entry, not entries[0].
+"""
+
+from __future__ import annotations
+
+import types
+
+import pytest
+
+from distributed_lms_raft_llm_tpu.client import gui
+from distributed_lms_raft_llm_tpu.proto import lms_pb2
+
+
+# --------------------------------------------------------------- fake toolkit
+
+
+class FakeWidget:
+    def __init__(self, master=None, **kw):
+        self.master = master
+        self.kw = kw
+        self.children = []
+        self.destroyed = False
+        if master is not None:
+            master.children.append(self)
+
+    def pack(self, **kw):
+        return self
+
+    def winfo_children(self):
+        return list(self.children)
+
+    def destroy(self):
+        self.destroyed = True
+        if self.master is not None and self in self.master.children:
+            self.master.children.remove(self)
+        for child in list(self.children):
+            child.destroy()
+
+
+class FakeTk(FakeWidget):
+    def __init__(self):
+        super().__init__(None)
+
+    def title(self, *_):
+        pass
+
+    def geometry(self, *_):
+        pass
+
+    def after(self, _ms, fn):
+        fn()
+
+    def mainloop(self):
+        pass
+
+
+class FakeFrame(FakeWidget):
+    pass
+
+
+class FakeLabel(FakeWidget):
+    pass
+
+
+class FakeButton(FakeWidget):
+    def invoke(self):
+        self.kw["command"]()
+
+
+class FakeEntry(FakeWidget):
+    def __init__(self, master=None, **kw):
+        super().__init__(master, **kw)
+        self.value = ""
+
+    def get(self):
+        return self.value
+
+    def insert(self, _index, text):
+        self.value += text
+
+    def delete(self, *_):
+        self.value = ""
+
+
+class FakeText(FakeWidget):
+    def __init__(self, master=None, **kw):
+        super().__init__(master, **kw)
+        self.value = ""
+
+    def get(self, *_):
+        return self.value
+
+    def insert(self, _index, text):
+        self.value += text
+
+
+class FakeListbox(FakeWidget):
+    def __init__(self, master=None, **kw):
+        super().__init__(master, **kw)
+        self.items = []
+        self._selection = ()
+
+    def insert(self, _index, item):
+        self.items.append(item)
+
+    def curselection(self):
+        return self._selection
+
+    def selection_set(self, index):
+        self._selection = (index,)
+
+
+class FakeVar:
+    def __init__(self, master=None, value=""):
+        self.value = value
+
+    def get(self):
+        return self.value
+
+    def set(self, value):
+        self.value = value
+
+
+class FakeRadiobutton(FakeWidget):
+    def invoke(self):
+        self.kw["variable"].set(self.kw["value"])
+
+
+def make_fake_tk():
+    ns = types.SimpleNamespace(
+        Tk=FakeTk,
+        Frame=FakeFrame,
+        Label=FakeLabel,
+        Button=FakeButton,
+        Entry=FakeEntry,
+        Text=FakeText,
+        Listbox=FakeListbox,
+        Radiobutton=FakeRadiobutton,
+        StringVar=FakeVar,
+        BOTH="both",
+        X="x",
+        END="end",
+        LEFT="left",
+        RIGHT="right",
+        BOTTOM="bottom",
+    )
+    return ns
+
+
+class Recorder:
+    def __init__(self):
+        self.calls = []
+        self.preset = {}
+
+    def __getattr__(self, name):
+        def record(*args, **kw):
+            self.calls.append((name, args))
+            return self.preset.get(name)
+
+        return record
+
+
+# ------------------------------------------------------------- widget helpers
+
+
+def widgets(root, cls):
+    out = []
+    queue = [root]
+    while queue:
+        w = queue.pop(0)
+        if isinstance(w, cls):
+            out.append(w)
+        queue.extend(w.children)
+    return out
+
+
+def button(app, text):
+    for b in widgets(app.body, FakeButton):
+        if b.kw.get("text") == text:
+            return b
+    raise AssertionError(
+        f"no button {text!r}; have "
+        f"{[b.kw.get('text') for b in widgets(app.body, FakeButton)]}"
+    )
+
+
+def entries(app):
+    return widgets(app.body, FakeEntry)
+
+
+# ---------------------------------------------------------------- fake client
+
+
+def entry(**kw):
+    return lms_pb2.DataEntry(**kw)
+
+
+class ScriptedClient:
+    """LMSClient stand-in: records mutations, serves canned reads."""
+
+    def __init__(self, role="student"):
+        self.role_after_login = role
+        self.role = None
+        self.token = None
+        self.calls = []
+        self.materials = [
+            entry(filename="week1.pdf", instructor="prof", file=b"AAA"),
+            entry(filename="week2.pdf", instructor="prof", file=b"BBB"),
+        ]
+        self.assignments = [
+            entry(id="alice", filename="hw.pdf", file=b"HW"),
+            entry(id="bob", filename="hw2.pdf", file=b"HW2"),
+        ]
+        self.queries = [entry(id="alice", data="what is Raft?")]
+
+    def register(self, username, password, role):
+        self.calls.append(("register", username, role))
+        return lms_pb2.RegisterResponse(success=True, message="registered")
+
+    def login(self, username, password):
+        self.calls.append(("login", username))
+        self.role = self.role_after_login
+        self.token = "tok"
+        return True
+
+    def logout(self):
+        self.calls.append(("logout",))
+        self.role = self.token = None
+        return True
+
+    def course_materials(self):
+        return self.materials
+
+    def student_assignments(self):
+        return self.assignments
+
+    def unanswered_queries(self):
+        return self.queries
+
+    def instructor_responses(self):
+        return [entry(data="read chapter 3")]
+
+    def my_grade(self):
+        return "A"
+
+    def grade(self, student, grade):
+        self.calls.append(("grade", student, grade))
+        return lms_pb2.GradeResponse(success=True, message=f"graded {student}")
+
+    def respond_to_query(self, student, response):
+        self.calls.append(("respond", student, response))
+        return True
+
+    def ask_llm(self, query):
+        self.calls.append(("ask_llm", query))
+        return lms_pb2.QueryResponse(success=True, response="42")
+
+    def ask_instructor(self, query):
+        self.calls.append(("ask_instructor", query))
+        return True
+
+    def upload_assignment(self, name, content):
+        self.calls.append(("upload_assignment", name, content))
+        return True
+
+    def upload_course_material(self, name, content):
+        self.calls.append(("upload_material", name, content))
+        return True
+
+
+# -------------------------------------------------------------------- fixture
+
+
+@pytest.fixture()
+def app(monkeypatch):
+    fake_tk = make_fake_tk()
+    msg = Recorder()
+    dlg = Recorder()
+    dlg.preset = {}
+    monkeypatch.setattr(gui, "tk", fake_tk)
+    monkeypatch.setattr(gui, "messagebox", msg)
+    monkeypatch.setattr(gui, "filedialog", dlg)
+    client = ScriptedClient()
+    application = gui.LMSApp(client, root=FakeTk(), background=False)
+    application.msg = msg
+    application.dlg = dlg
+    yield application
+
+
+def login_as(app, role):
+    app.client.role_after_login = role
+    button(app, "Login").invoke()
+    user, pw = entries(app)[:2]
+    user.insert(0, "u")
+    pw.insert(0, "p")
+    button(app, "Login").invoke()
+
+
+# ----------------------------------------------------------------------- tests
+
+
+def test_welcome_screen_has_entry_points(app):
+    for label in ("Login", "Register", "Quit"):
+        button(app, label)
+
+
+def test_register_flow(app):
+    button(app, "Register").invoke()
+    user, pw = entries(app)[:2]
+    user.insert(0, "newbie")
+    pw.insert(0, "secret")
+    # pick the instructor radio
+    for rb in widgets(app.body, FakeRadiobutton):
+        if rb.kw.get("value") == "instructor":
+            rb.invoke()
+    button(app, "Register").invoke()
+    assert ("register", "newbie", "instructor") in app.client.calls
+    assert any(c[0] == "showinfo" for c in app.msg.calls)
+    # success returns to the welcome screen
+    button(app, "Login")
+
+
+def test_register_requires_fields(app):
+    button(app, "Register").invoke()
+    button(app, "Register").invoke()  # empty submit
+    assert any(c[0] == "showwarning" for c in app.msg.calls)
+    assert not app.client.calls
+
+
+def test_student_journey(app, tmp_path):
+    login_as(app, "student")
+    button(app, "View course materials")  # student menu rendered
+
+    # materials list shows both files
+    button(app, "View course materials").invoke()
+    box = widgets(app.body, FakeListbox)[0]
+    assert len(box.items) == 2 and "week1.pdf" in box.items[0]
+    button(app, "Back").invoke()
+
+    # D8 regression: download saves the SELECTED entry (index 1)
+    button(app, "Download course material").invoke()
+    box = widgets(app.body, FakeListbox)[0]
+    box.selection_set(1)
+    target = tmp_path / "week2.pdf"
+    app.dlg.preset["asksaveasfilename"] = str(target)
+    button(app, "Save selected").invoke()
+    assert target.read_bytes() == b"BBB"
+
+    button(app, "Back").invoke()
+    button(app, "View my grade").invoke()
+    labels = [w.kw.get("text") for w in widgets(app.body, FakeLabel)]
+    assert "A" in labels
+    button(app, "Back").invoke()
+
+    # ask the LLM
+    button(app, "Ask a query").invoke()
+    widgets(app.body, FakeText)[0].insert(0, "what is a mesh?")
+    button(app, "Submit").invoke()
+    assert ("ask_llm", "what is a mesh?") in app.client.calls
+    assert any(c == ("showinfo", (gui.TITLE, "42")) for c in app.msg.calls)
+
+    # ask the instructor instead
+    for rb in widgets(app.body, FakeRadiobutton):
+        if rb.kw.get("value") == "instructor":
+            rb.invoke()
+    button(app, "Submit").invoke()
+    assert ("ask_instructor", "what is a mesh?") in app.client.calls
+    button(app, "Back").invoke()
+
+    # typed-text assignment upload goes through the PDF synthesizer
+    button(app, "Upload assignment").invoke()
+    widgets(app.body, FakeText)[0].insert(0, "my essay")
+    button(app, "Upload typed text as PDF").invoke()
+    upload = next(c for c in app.client.calls if c[0] == "upload_assignment")
+    assert upload[1] == "typed.pdf" and upload[2].startswith(b"%PDF")
+    button(app, "Back").invoke()
+
+    button(app, "View instructor responses").invoke()
+    box = widgets(app.body, FakeListbox)[0]
+    assert box.items == ["read chapter 3"]
+    button(app, "Back").invoke()
+
+    button(app, "Logout").invoke()
+    assert ("logout",) in app.client.calls
+    button(app, "Register")  # back on welcome
+
+
+def test_instructor_grading_and_responses(app):
+    login_as(app, "instructor")
+
+    button(app, "View & grade assignments").invoke()
+    box = widgets(app.body, FakeListbox)[0]
+    assert len(box.items) == 2
+    box.selection_set(1)  # bob
+    entries(app)[-1].insert(0, "B+")
+    button(app, "Submit grade").invoke()
+    assert ("grade", "bob", "B+") in app.client.calls
+    button(app, "Back").invoke()
+
+    button(app, "View unanswered queries").invoke()
+    box = widgets(app.body, FakeListbox)[0]
+    assert "what is Raft?" in box.items[0]
+    button(app, "Back").invoke()
+
+    button(app, "Respond to a query").invoke()
+    widgets(app.body, FakeListbox)[0].selection_set(0)
+    widgets(app.body, FakeText)[0].insert(0, "log replication")
+    button(app, "Send response").invoke()
+    assert ("respond", "alice", "log replication") in app.client.calls
+
+
+def test_grade_requires_selection(app):
+    login_as(app, "instructor")
+    button(app, "View & grade assignments").invoke()
+    button(app, "Submit grade").invoke()  # nothing selected
+    assert any(c[0] == "showwarning" for c in app.msg.calls)
+    assert not any(c[0] == "grade" for c in app.client.calls)
+
+
+def test_rpc_failure_surfaces_as_error_dialog(app):
+    def boom():
+        raise RuntimeError("leader lost")
+
+    app.client.my_grade = boom
+    login_as(app, "student")
+    button(app, "View my grade").invoke()
+    assert any(c[0] == "showerror" for c in app.msg.calls)
