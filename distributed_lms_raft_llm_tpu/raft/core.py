@@ -122,19 +122,17 @@ class RaftCore:
             )
         self.commit_index = last_applied
         self.last_applied = last_applied
-        # Follower side: a freshly installed snapshot the runner must hand
-        # to the application ((index, data) or None).
+        # Follower side: a staged snapshot the runner must hand to the
+        # application ((index, data) or None). Raft state does NOT advance
+        # until commit_installed_snapshot — see on_install_snapshot.
         self.pending_snapshot: Optional[Tuple[int, bytes]] = None
+        self._staged_install: Optional[InstallSnapshotRequest] = None
         self.votes: Set[int] = set()
         self.next_index: Dict[int, int] = {}
         self.match_index: Dict[int, int] = {}
         self._last_heartbeat_sent = 0.0
         # peer -> time the last InstallSnapshot was dispatched (throttle).
         self._snapshot_sent_at: Dict[int, float] = {}
-        # Set while an installed snapshot awaits durable WAL replacement
-        # (ordering: the app persists its state snapshot FIRST, then the WAL
-        # compacts — see persist_installed_snapshot).
-        self._storage_install_pending = False
 
         # (peer_id, message) pairs for the runner to deliver.
         self.outbox: List[Tuple[int, object]] = []
@@ -470,6 +468,28 @@ class RaftCore:
             # Already at/past this point; nothing to install.
             return InstallSnapshotResponse(term=self.current_term, success=True)
 
+        # Stage only: raft state must not move until the application has
+        # durably installed the snapshot. If the install callback fails, the
+        # runner aborts the staging and answers success=False, and because
+        # last_applied never advanced the leader's retry re-attempts the
+        # install instead of being absorbed by the early-return above and
+        # streaming entries past a hole the app never filled.
+        self._staged_install = req
+        self.pending_snapshot = (req.last_included_index, req.data)
+        return InstallSnapshotResponse(term=self.current_term, success=True)
+
+    def commit_installed_snapshot(self) -> None:
+        """Advance raft state + durable WAL to the staged snapshot.
+
+        Called by the runner AFTER the application persisted its state
+        snapshot (durable ordering: a crash between the two leaves the app
+        snapshot ahead of the WAL base, which boot replays past; compacting
+        the WAL first would leave a base ahead of the app, which the boot
+        check rejects as unrecoverable)."""
+        req = self._staged_install
+        if req is None:
+            return
+        self._staged_install = None
         if (
             req.last_included_index <= self.last_log_index
             and self.entry_term(req.last_included_index)
@@ -485,26 +505,13 @@ class RaftCore:
         self.snapshot_data = req.data
         self.commit_index = max(self.commit_index, req.last_included_index)
         self.last_applied = req.last_included_index
-        # Durable ordering: the WAL must not compact before the application
-        # persists the state snapshot — a crash in between would leave a WAL
-        # whose base is ahead of the app state, which the boot check rejects
-        # as unrecoverable. The runner calls install_cb (app persists) and
-        # then persist_installed_snapshot(); both happen synchronously
-        # before the response leaves this node.
-        self._storage_install_pending = True
-        # The runner hands this to the application, which replaces its whole
-        # state (apply resumes from last_included_index + 1).
-        self.pending_snapshot = (req.last_included_index, req.data)
-        return InstallSnapshotResponse(term=self.current_term, success=True)
+        self.storage.install_snapshot(
+            self.snapshot_index, self.snapshot_term, self.log
+        )
 
-    def persist_installed_snapshot(self) -> None:
-        """Durably replace the WAL with the installed snapshot base + suffix
-        (called by the runner AFTER the app persisted its state snapshot)."""
-        if self._storage_install_pending:
-            self.storage.install_snapshot(
-                self.snapshot_index, self.snapshot_term, self.log
-            )
-            self._storage_install_pending = False
+    def abort_installed_snapshot(self) -> None:
+        """Drop a staged snapshot whose application install failed."""
+        self._staged_install = None
 
     def on_install_snapshot_response(
         self,

@@ -145,6 +145,24 @@ class RaftNode:
         self, req: InstallSnapshotRequest
     ) -> InstallSnapshotResponse:
         resp = self.core.on_install_snapshot(req, time.monotonic())
+        if self.core.pending_snapshot is not None:
+            index, data = self.core.pending_snapshot
+            self.core.pending_snapshot = None
+            try:
+                if self.install_cb is not None:
+                    self.install_cb(index, data)
+                # App state is durable; now raft state + WAL may advance.
+                self.core.commit_installed_snapshot()
+            except Exception:
+                # Raft state never advanced, so answering success=False makes
+                # the leader re-send the snapshot (after its resend throttle)
+                # instead of streaming entries past a hole the app never
+                # filled; this node keeps serving from its old state.
+                log.exception("snapshot install failed at %d", index)
+                self.core.abort_installed_snapshot()
+                resp = InstallSnapshotResponse(
+                    term=self.core.current_term, success=False
+                )
         self._pump()
         return resp
 
@@ -164,25 +182,6 @@ class RaftNode:
 
     def _pump(self) -> None:
         """Apply newly committed entries and dispatch outbound messages."""
-        if self.core.pending_snapshot is not None:
-            index, data = self.core.pending_snapshot
-            self.core.pending_snapshot = None
-            if self.install_cb is not None:
-                try:
-                    self.install_cb(index, data)
-                except Exception:
-                    # Fail fast: raft state already advanced to the snapshot
-                    # point; proceeding with an app that never installed it
-                    # would silently diverge (same contract as the boot
-                    # checks in lms/node.py).
-                    log.exception("snapshot install callback failed at %d",
-                                  index)
-                    raise
-            # Durable ordering (core.on_install_snapshot docstring): the app
-            # has persisted its state snapshot, so the WAL may now be
-            # replaced with the new base + suffix — before the RPC response
-            # leaves this node.
-            self.core.persist_installed_snapshot()
         for index, entry in self.core.take_applies():
             self._resolve_waiters(index, entry)
             if self.apply_cb is not None and entry.command != NOOP:

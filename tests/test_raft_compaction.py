@@ -145,33 +145,48 @@ def test_wiped_follower_converges_via_install_snapshot(tmp_path):
     asyncio.run(run())
 
 
-def test_install_callback_failure_fails_fast():
-    """If the app cannot persist an installed snapshot, the node must not
-    keep serving with raft state claiming an apply point the application
-    never reached (ADVICE r3 #2): the RPC handler raises instead of
-    silently proceeding, and the WAL keeps its old base."""
+def test_install_callback_failure_rejects_and_retry_converges():
+    """If the app cannot persist an installed snapshot, raft state must not
+    advance past it (ADVICE r3 #2): the response is success=False (so the
+    leader re-sends instead of streaming entries past a hole), last_applied
+    and the WAL base stay put, and a later retry — app recovered — installs
+    cleanly. The earlier fail-fast-by-raising design didn't actually stop
+    anything: neither transport turns the exception into a crash, and the
+    leader's retry was absorbed by the last_applied early-return."""
     from distributed_lms_raft_llm_tpu.raft.messages import (
         InstallSnapshotRequest,
     )
     from distributed_lms_raft_llm_tpu.raft.node import RaftNode, Transport
     from distributed_lms_raft_llm_tpu.raft.storage import MemoryStorage
 
-    def bad_install(index, data):
-        raise IOError("disk full")
+    installed = []
+
+    def flaky_install(index, data):
+        if not installed:
+            installed.append("failed")
+            raise IOError("disk full")
+        installed.append((index, data))
 
     storage = MemoryStorage()
     node = RaftNode(2, [1, 2, 3], storage, Transport(),
-                    config=FAST, install_cb=bad_install)
+                    config=FAST, install_cb=flaky_install)
     req = InstallSnapshotRequest(
         term=1, leader_id=1, last_included_index=5, last_included_term=1,
         data=b"{}",
     )
-    try:
-        node.handle_install_snapshot(req)
-        raised = False
-    except IOError:
-        raised = True
-    assert raised, "install failure must propagate, not be swallowed"
-    # Durable storage never compacted to the uninstalled base.
+    resp = node.handle_install_snapshot(req)
+    assert resp.success is False
+    # Nothing moved: raft state pre-install, WAL base untouched.
+    assert node.core.last_applied == 0
+    assert node.core.snapshot_index == 0
     _, _, _, snap_idx, _ = storage.load()
     assert snap_idx == 0
+
+    # Leader retries (same request); the app has recovered.
+    resp2 = node.handle_install_snapshot(req)
+    assert resp2.success is True
+    assert installed[-1] == (5, b"{}")
+    assert node.core.last_applied == 5
+    assert node.core.snapshot_index == 5
+    _, _, _, snap_idx2, snap_term2 = storage.load()
+    assert (snap_idx2, snap_term2) == (5, 1)
