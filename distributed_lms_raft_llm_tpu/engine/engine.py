@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models import convert, registry
+from ..models import convert, quant, registry
 from ..parallel import mesh as mesh_lib
 from ..parallel import partition
 from ..utils import tokenizer as tok_lib
@@ -53,6 +53,21 @@ class EngineConfig:
     length_buckets: Tuple[int, ...] = (32, 64, 128, 256)
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     tp: int = 1  # tensor-parallel ways; dp absorbs remaining devices
+    # Fused Pallas decode attention (ops/attention.py). None = off: with the
+    # cache's [.., S, 64] head-dim-minor layout the kernel's DMA runs at
+    # half-filled 128-lane tiles and measured slightly SLOWER end-to-end
+    # than XLA's einsum fusions (9.4k vs 9.9k tok/s, BENCH history); it
+    # stays available for explicit experiments (True) and as the base for a
+    # lane-packed cache layout. Not partition-aware: requires mesh size 1.
+    fused_attention: Optional[bool] = None
+    # Weight-only int8 ("int8") halves the parameter bytes the decode loop
+    # streams per step (models/quant.py) — the dominant cost on the bench
+    # chip. None = full-precision (bf16) weights. Requires tp=1: the
+    # partition rules don't cover the quantized leaf pairs.
+    quant: Optional[str] = None
+    # int8 KV cache (per-slot scales, models/common.quantize_kv): halves
+    # the attention bytes per decode step. Orthogonal to `quant`.
+    kv_quant: bool = False
     dtype: Any = jnp.bfloat16
     # Serving stores weights in bf16: halves the HBM read per decode step
     # versus f32 (the decode loop is memory-bound — every step streams all
@@ -73,6 +88,15 @@ class TutoringEngine:
             config.model, config.dtype, config.param_dtype
         )
         self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1}, devices=devices)
+        if config.fused_attention:
+            if self.mesh.devices.size != 1:
+                raise ValueError(
+                    "fused_attention requires an unsharded (single-device) "
+                    "mesh — the pallas kernel is not partition-aware"
+                )
+            self.cfg = dataclasses.replace(self.cfg, fused_decode_attention=True)
+        if config.kv_quant:
+            self.cfg = dataclasses.replace(self.cfg, quant_kv=True)
         self.tokenizer = tok_lib.load_gpt2_tokenizer(
             config.vocab_path, config.merges_path, config.tokenizer_json
         )
@@ -107,6 +131,15 @@ class TutoringEngine:
             log.warning("no checkpoint configured — randomly initialized %s",
                         config.model)
             params = self.family.init_params(jax.random.key(config.seed), self.cfg)
+        if config.quant:
+            if config.quant != "int8":
+                raise ValueError(f"unsupported quant mode {config.quant!r}")
+            if config.tp != 1:
+                raise ValueError(
+                    "quant='int8' requires tp=1 (partition rules cover "
+                    "dense leaves only)"
+                )
+            params = quant.quantize_params(params, self.family.name)
         rules = partition.RULES_FOR[self.family.name]
         self.params = partition.shard_tree(params, self.mesh, rules)
         log.info("params ready in %.1fs (mesh %s)", time.monotonic() - t0,
@@ -202,7 +235,9 @@ class TutoringEngine:
             if measure_ttft:
                 np.asarray(state.out[:, 0])  # blocks until the first token exists
                 self.last_ttft_s = time.monotonic() - t0
-            result = self._decode(self.params, state)
+            # The final state is returned (and dropped) purely so the donated
+            # input state aliases into same-shaped outputs — see decode().
+            result, _ = self._decode(self.params, state)
         return result if device_result else jax.device_get(result)
 
     def answer_batch(self, prompts: Sequence[str]) -> List[str]:

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import convert, registry
+from ..models import quant as quant_lib
 from ..models.common import KVCache
 from ..parallel import mesh as mesh_lib
 from ..parallel import partition
@@ -74,10 +75,11 @@ class _Request:
 
 
 def _prefill_program(params, ids, true_len, rng, *, cfg, sampling, model):
-    """[1, T] right-padded prompt -> (k, v, first_tok, seen_row).
+    """[1, T] right-padded prompt -> (cache, first_tok, seen_row).
 
-    The returned k/v are the single-slot cache [L, 1, H, Tmax, Dh] with the
-    prompt occupying positions 0..true_len-1.
+    The returned cache is the single-slot cache [L, 1, H, Tmax, Dh] (plus
+    scale planes when int8-quantized) with the prompt occupying positions
+    0..true_len-1.
     """
     _, t = ids.shape
     cache = model.init_cache(cfg, 1, cfg_tmax(cfg, sampling, t), dtype=cfg.dtype)
@@ -92,22 +94,34 @@ def _prefill_program(params, ids, true_len, rng, *, cfg, sampling, model):
     valid = (jnp.arange(t) < true_len)[None, :]
     seen = seen_mask_from_ids(ids, valid, cfg.vocab_size)[0]
     first = sample_step(rng, last[None, :], seen[None, :], sampling)[0]
-    return cache.k, cache.v, first, update_seen(seen[None, :], first[None])[0]
+    return cache, first, update_seen(seen[None, :], first[None])[0]
 
 
 def cfg_tmax(cfg, sampling: SamplingParams, bucket: int) -> int:
     return min(bucket + sampling.max_new_tokens, cfg.max_position_embeddings)
 
 
-def _install_program(state: SlotState, slot, k1, v1, true_len, first, seen_row,
-                     *, eos_id: int) -> SlotState:
+def _install_program(state: SlotState, slot, c1: KVCache, true_len, first,
+                     seen_row, *, eos_id: int) -> SlotState:
     """Splice a prefilled slot into the live state (one fused program)."""
     zero = jnp.zeros((), jnp.int32)
-    ck = jax.lax.dynamic_update_slice(state.cache.k, k1, (zero, slot, zero, zero, zero))
-    cv = jax.lax.dynamic_update_slice(state.cache.v, v1, (zero, slot, zero, zero, zero))
+    ck = jax.lax.dynamic_update_slice(
+        state.cache.k, c1.k, (zero, slot, zero, zero, zero)
+    )
+    cv = jax.lax.dynamic_update_slice(
+        state.cache.v, c1.v, (zero, slot, zero, zero, zero)
+    )
+    cks = cvs = None
+    if state.cache.quantized:
+        cks = jax.lax.dynamic_update_slice(
+            state.cache.ks, c1.ks, (zero, slot, zero, zero)
+        )
+        cvs = jax.lax.dynamic_update_slice(
+            state.cache.vs, c1.vs, (zero, slot, zero, zero)
+        )
     lengths = state.cache.length.at[slot].set(true_len)
     return SlotState(
-        cache=KVCache(ck, cv, lengths),
+        cache=KVCache(ck, cv, lengths, ks=cks, vs=cvs),
         tok=state.tok.at[slot].set(first),
         active=state.active.at[slot].set(first != eos_id),
         seen=state.seen.at[slot].set(seen_row),
@@ -121,7 +135,7 @@ def _step_program(params, state: SlotState, rng, *, cfg, sampling,
     # Inactive/full slots write into their current position; clamp to stay
     # in bounds — the slot is dead or about to be evicted, the data ignored.
     offs = jnp.minimum(state.cache.length, tmax - 1)
-    cache = KVCache(state.cache.k, state.cache.v, offs)
+    cache = state.cache._replace(length=offs)
     kv_mask = jnp.arange(tmax)[None, :] <= offs[:, None]
     logits, cache = model.forward(
         params, cfg, state.tok[:, None], cache=cache, kv_mask=kv_mask
@@ -137,7 +151,7 @@ def _step_program(params, state: SlotState, rng, *, cfg, sampling,
     )
     return (
         SlotState(
-            cache=KVCache(cache.k, cache.v, lengths),
+            cache=cache._replace(length=lengths),
             tok=nxt,
             active=still,
             seen=seen,
@@ -163,6 +177,8 @@ class PagedEngine:
         self.family, self.cfg = registry.resolve(
             config.model, config.dtype, config.param_dtype
         )
+        if config.kv_quant:
+            self.cfg = dataclasses.replace(self.cfg, quant_kv=True)
         self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1},
                                        devices=devices)
         self.tokenizer = tok_lib.load_gpt2_tokenizer(
@@ -192,6 +208,12 @@ class PagedEngine:
         else:
             log.warning("no checkpoint — randomly initialized %s", config.model)
             params = self.family.init_params(jax.random.key(config.seed), self.cfg)
+        if config.quant:
+            if config.quant != "int8":
+                raise ValueError(f"unsupported quant mode {config.quant!r}")
+            if config.tp != 1:
+                raise ValueError("quant='int8' requires tp=1")
+            params = quant_lib.quantize_params(params, self.family.name)
         rules = partition.RULES_FOR[self.family.name]
         self.params = partition.shard_tree(params, self.mesh, rules)
 
@@ -222,8 +244,7 @@ class PagedEngine:
     def _init_state(self) -> SlotState:
         cache = self.family.init_cache(self.cfg, self.slots, self.tmax,
                                        dtype=self.cfg.dtype)
-        cache = KVCache(cache.k, cache.v,
-                        jnp.zeros((self.slots,), jnp.int32))
+        cache = cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
         return SlotState(
             cache=cache,
             tok=jnp.zeros((self.slots,), jnp.int32),
@@ -291,12 +312,12 @@ class PagedEngine:
             ids[0, : req.prompt_len] = req.tokens
             self._rng, rng = jax.random.split(self._rng)
             with self.mesh:
-                k1, v1, first, seen_row = self._prefill(
+                c1, first, seen_row = self._prefill(
                     self.params, jnp.asarray(ids),
                     jnp.asarray(req.prompt_len, jnp.int32), rng,
                 )
                 self.state = self._install(
-                    self.state, jnp.asarray(slot, jnp.int32), k1, v1,
+                    self.state, jnp.asarray(slot, jnp.int32), c1,
                     jnp.asarray(req.prompt_len, jnp.int32), first, seen_row,
                 )
             admitted.append((slot, req, first))

@@ -21,7 +21,7 @@ Conventions
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,9 +46,20 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     return (y * scale.astype(jnp.float32)).astype(dtype)
 
 
-def dense(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Array:
-    """x @ w (+ b). Weights stored [in, out] so no transposes reach the MXU."""
-    y = jnp.einsum("...i,io->...o", x, w.astype(x.dtype))
+def dense(x: jax.Array, w, b: Optional[jax.Array] = None) -> jax.Array:
+    """x @ w (+ b). Weights stored [in, out] so no transposes reach the MXU.
+
+    `w` is either a dense array or a weight-only-int8 pair
+    `{"q": int8 [in, out], "s": f32 [out]}` (models/quant.py): the int8
+    operand streams from HBM at half the bytes, the convert to the compute
+    dtype fuses into the matmul's operand load, and the per-out-channel
+    scale folds into the output.
+    """
+    if isinstance(w, dict):
+        y = jnp.einsum("...i,io->...o", x, w["q"].astype(x.dtype))
+        y = y * w["s"].astype(y.dtype)
+    else:
+        y = jnp.einsum("...i,io->...o", x, w.astype(x.dtype))
     if b is not None:
         y = y + b.astype(y.dtype)
     return y
@@ -59,6 +70,10 @@ class KVCache(NamedTuple):
 
     k, v: [num_layers, batch, num_kv_heads, max_len, head_dim]
     length: [] int32 — number of valid positions already written.
+    ks, vs: per-slot dequantization scales [L, B, Hkv, max_len] f32 when the
+            cache is int8-quantized (halves the HBM bytes the decode loop
+            streams per layer — see `quantize_kv`/`attend_quant`); None for
+            a full-precision cache.
 
     A single scalar length serves the whole batch; per-sequence raggedness is
     handled above the model by the engine's bucketing/batching (engine.paged
@@ -68,6 +83,12 @@ class KVCache(NamedTuple):
     k: jax.Array
     v: jax.Array
     length: jax.Array
+    ks: Optional[jax.Array] = None
+    vs: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.ks is not None
 
     @classmethod
     def create(
@@ -78,13 +99,65 @@ class KVCache(NamedTuple):
         max_len: int,
         head_dim: int,
         dtype=jnp.bfloat16,
+        quantized: bool = False,
     ) -> "KVCache":
         shape = (num_layers, batch, num_kv_heads, max_len, head_dim)
+        if quantized:
+            sshape = shape[:-1]
+            return cls(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                length=jnp.zeros((), jnp.int32),
+                ks=jnp.zeros(sshape, jnp.float32),
+                vs=jnp.zeros(sshape, jnp.float32),
+            )
         return cls(
             k=jnp.zeros(shape, dtype),
             v=jnp.zeros(shape, dtype),
             length=jnp.zeros((), jnp.int32),
         )
+
+
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-(batch, head, slot) int8: [B, H, T, Dh] -> (int8 same
+    shape, f32 [B, H, T] scales). One scale per cache slot keeps the
+    dequant outside the attention dots (scores scale by ks on the
+    un-contracted slot axis; vs folds into the probabilities)."""
+    xf = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(xf), axis=-1) / 127.0  # [B, H, T]
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def attend_quant(
+    q: jax.Array,
+    k_q: jax.Array,
+    ks: jax.Array,
+    v_q: jax.Array,
+    vs: jax.Array,
+    mask: jax.Array,
+) -> jax.Array:
+    """`attend` against an int8 cache: q [B,H,T,Dh], k_q/v_q int8
+    [B,H,S,Dh], ks/vs f32 [B,H,S], mask [B,1,T,S].
+
+    Both dequant multiplies stay OUTSIDE the dots — ks scales the score
+    matrix on its un-contracted slot axis, vs folds into the (tiny)
+    probability matrix — so the int8 operands feed the MXU directly and
+    HBM sees half the bytes of a bf16 cache.
+    """
+    dtype = q.dtype
+    head_dim = q.shape[-1]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_q.astype(dtype),
+        preferred_element_type=jnp.float32,
+    )
+    scores = scores * ks[:, :, None, :]
+    scores = scores / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = (probs * vs[:, :, None, :]).astype(dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v_q.astype(dtype))
 
 
 def attend(

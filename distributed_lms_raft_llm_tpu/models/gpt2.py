@@ -25,13 +25,17 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops import attention as attention_ops
+from . import quant
 from .common import (
     KVCache,
     attend,
+    attend_quant,
     causal_window_mask,
     dense,
     layer_norm,
     merge_heads,
+    quantize_kv,
     split_heads,
 )
 
@@ -48,6 +52,15 @@ class GPT2Config:
     layer_norm_eps: float = 1e-5
     dtype: Any = jnp.float32  # compute dtype; bfloat16 on TPU
     param_dtype: Any = jnp.float32
+    # Route the single-token decode step through the fused Pallas attention
+    # kernel (ops/attention.py). Static (cfg is a jit static arg); the
+    # engine turns it on for unsharded TPU serving — the kernel is not
+    # partition-aware, so sharded/CPU paths keep the XLA einsums.
+    fused_decode_attention: bool = False
+    # int8 KV cache with per-slot scales (common.quantize_kv): halves the
+    # HBM bytes every decode step streams for attention. Set by the engine
+    # (EngineConfig.kv_quant); mutually exclusive with the pallas kernel.
+    quant_kv: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -118,7 +131,8 @@ def init_params(rng: jax.Array, cfg: GPT2Config) -> Params:
 
 def init_cache(cfg: GPT2Config, batch: int, max_len: int, dtype=None) -> KVCache:
     return KVCache.create(
-        cfg.num_layers, batch, cfg.num_heads, max_len, cfg.head_dim, dtype or cfg.dtype
+        cfg.num_layers, batch, cfg.num_heads, max_len, cfg.head_dim,
+        dtype or cfg.dtype, quantized=cfg.quant_kv,
     )
 
 
@@ -160,7 +174,7 @@ def forward(
     if positions is None:
         positions = q_slots
 
-    x = params["wte"][input_ids] + params["wpe"][positions]
+    x = quant.embed_lookup(params["wte"], input_ids) + params["wpe"][positions]
     x = x.astype(cfg.dtype)
 
     num_keys = t if cache is None else cache.k.shape[3]
@@ -168,17 +182,20 @@ def forward(
     if kv_mask is not None:
         mask = mask & kv_mask[:, None, None, :]
 
-    def block(x, layer_params, kv_fn):
-        """One transformer block; `kv_fn(k_new, v_new) -> (k_att, v_att)`
-        injects the cache handling so both paths share one copy of the math.
+    def block(x, layer_params, attend_fn):
+        """One transformer block; `attend_fn(q, k_new, v_new) -> context`
+        owns cache handling + attention so both paths share one copy of
+        the math.
         """
         lp = layer_params
         h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], eps)
         qkv = dense(h, lp["attn"]["wqkv"], lp["attn"]["bqkv"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = split_heads(q, num_heads)
-        k_att, v_att = kv_fn(split_heads(k, num_heads), split_heads(v, num_heads))
-        a = attend(q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask)
+        a = attend_fn(
+            split_heads(q, num_heads),
+            split_heads(k, num_heads),
+            split_heads(v, num_heads),
+        )
         x = x + dense(merge_heads(a), lp["attn"]["wo"], lp["attn"]["bo"])
         h2 = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], eps)
         m = dense(h2, lp["mlp"]["wi"], lp["mlp"]["bi"])
@@ -188,7 +205,9 @@ def forward(
 
     if cache is None:
         def body(carry, lp):
-            return block(carry, lp, lambda k, v: (k, v)), None
+            return block(
+                carry, lp, lambda q, k, v: attend(q, k, v, mask)
+            ), None
 
         x, _ = jax.lax.scan(body, x, params["blocks"])
         new_cache = None
@@ -200,51 +219,91 @@ def forward(
         # roofline on a v5e; as carry the update aliases and the decode step
         # drops from ~1.23 ms to ~0.66 ms (batch 8, GPT-2-small).
         zero = jnp.zeros((), jnp.int32)
+        fused = cfg.fused_decode_attention and t == 1
+        if cfg.fused_decode_attention and cfg.quant_kv:
+            raise ValueError(
+                "fused_decode_attention and quant_kv are mutually exclusive "
+                "(the pallas kernel reads a full-precision cache)"
+            )
+        quant_kv = cfg.quant_kv
+        # The attend-mask is layer-invariant; its additive-bias form is
+        # computed once per step, outside the layer scan.
+        bias = attention_ops.mask_to_bias(mask) if fused else None
 
         def body(carry, xs):
-            x, ck, cv = carry
+            x, ck, cv, cks, cvs = carry
             lp, layer = xs
             updated = {}
 
-            def kv_fn(k_new, v_new):
+            def attend_fn(q, k_new, v_new):
+                if quant_kv:
+                    k_w, k_s = quantize_kv(k_new)
+                    v_w, v_s = quantize_kv(v_new)
+                else:
+                    k_w, v_w = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
+                cks2, cvs2 = cks, cvs
                 if offset.ndim == 1:  # ragged slots: scatter at per-row pos
                     rows = jnp.arange(k_new.shape[0])
-                    ck2 = ck.at[layer, rows, :, offset, :].set(
-                        k_new[:, :, 0, :].astype(ck.dtype)
-                    )
-                    cv2 = cv.at[layer, rows, :, offset, :].set(
-                        v_new[:, :, 0, :].astype(cv.dtype)
-                    )
+                    ck2 = ck.at[layer, rows, :, offset, :].set(k_w[:, :, 0, :])
+                    cv2 = cv.at[layer, rows, :, offset, :].set(v_w[:, :, 0, :])
+                    if quant_kv:
+                        cks2 = cks.at[layer, rows, :, offset].set(k_s[:, :, 0])
+                        cvs2 = cvs.at[layer, rows, :, offset].set(v_s[:, :, 0])
                 else:
                     start = (layer, zero, zero, offset, zero)
-                    ck2 = jax.lax.dynamic_update_slice(
-                        ck, k_new.astype(ck.dtype)[None], start
+                    ck2 = jax.lax.dynamic_update_slice(ck, k_w[None], start)
+                    cv2 = jax.lax.dynamic_update_slice(cv, v_w[None], start)
+                    if quant_kv:
+                        s_start = (layer, zero, zero, offset)
+                        cks2 = jax.lax.dynamic_update_slice(
+                            cks, k_s[None], s_start
+                        )
+                        cvs2 = jax.lax.dynamic_update_slice(
+                            cvs, v_s[None], s_start
+                        )
+                updated.update(k=ck2, v=cv2, ks=cks2, vs=cvs2)
+                if fused:
+                    # Reads the layer's K/V straight out of the stacked
+                    # cache (scalar-prefetched layer index) — slicing the
+                    # layer first would copy 2×[B,H,S,Dh] per layer.
+                    return attention_ops.decode_attention(
+                        q, ck2, cv2, layer, bias
                     )
-                    cv2 = jax.lax.dynamic_update_slice(
-                        cv, v_new.astype(cv.dtype)[None], start
+                k_att = jax.lax.dynamic_index_in_dim(
+                    ck2, layer, 0, keepdims=False
+                )
+                v_att = jax.lax.dynamic_index_in_dim(
+                    cv2, layer, 0, keepdims=False
+                )
+                if quant_kv:
+                    return attend_quant(
+                        q,
+                        k_att,
+                        jax.lax.dynamic_index_in_dim(cks2, layer, 0,
+                                                     keepdims=False),
+                        v_att,
+                        jax.lax.dynamic_index_in_dim(cvs2, layer, 0,
+                                                     keepdims=False),
+                        mask,
                     )
-                updated["k"], updated["v"] = ck2, cv2
-                return (
-                    jax.lax.dynamic_index_in_dim(ck2, layer, 0, keepdims=False),
-                    jax.lax.dynamic_index_in_dim(cv2, layer, 0, keepdims=False),
+                return attend(
+                    q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask
                 )
 
-            y = block(x, lp, kv_fn)
-            return (y, updated["k"], updated["v"]), None
+            y = block(x, lp, attend_fn)
+            return (y, updated["k"], updated["v"], updated["ks"],
+                    updated["vs"]), None
 
         layers = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-        (x, new_k, new_v), _ = jax.lax.scan(
-            body, (x, cache.k, cache.v), (params["blocks"], layers)
+        (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
+            body, (x, cache.k, cache.v, cache.ks, cache.vs),
+            (params["blocks"], layers),
         )
-        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + t)
+        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + t,
+                            ks=new_ks, vs=new_vs)
 
     x = layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"], eps)
     # Tied unembedding (reference model ties lm_head to wte); f32 accumulation
     # so sampling sees full-precision logits even in bfloat16 compute.
-    logits = jnp.einsum(
-        "btd,vd->btv",
-        x,
-        params["wte"].astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    )
+    logits = quant.unembed(x, params["wte"])
     return logits, new_cache

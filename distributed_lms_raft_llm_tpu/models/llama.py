@@ -26,12 +26,16 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops import attention as attention_ops
+from . import quant
 from .common import (
     KVCache,
     attend,
+    attend_quant,
     causal_window_mask,
     dense,
     merge_heads,
+    quantize_kv,
     repeat_kv,
     rms_norm,
     split_heads,
@@ -53,6 +57,13 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.bfloat16
+    # Same contract as GPT2Config.fused_decode_attention; for GQA the
+    # kernel indexes shared KV heads directly, skipping the repeat_kv
+    # materialization as well.
+    fused_decode_attention: bool = False
+    # int8 KV cache with per-slot scales (common.quantize_kv); same
+    # contract as GPT2Config.quant_kv.
+    quant_kv: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -109,7 +120,7 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None) -> KVCache:
     return KVCache.create(
         cfg.num_layers, batch, cfg.num_kv_heads, max_len, cfg.head_dim,
-        dtype or cfg.dtype,
+        dtype or cfg.dtype, quantized=cfg.quant_kv,
     )
 
 
@@ -157,27 +168,21 @@ def forward(
     if positions is None:
         positions = q_slots
 
-    x = params["embed"][input_ids].astype(cfg.dtype)
+    x = quant.embed_lookup(params["embed"], input_ids).astype(cfg.dtype)
 
     num_keys = t if cache is None else cache.k.shape[3]
     mask = causal_window_mask(q_slots, num_keys)
     if kv_mask is not None:
         mask = mask & kv_mask[:, None, None, :]
 
-    def block(x, lp, kv_fn):
+    def block(x, lp, attend_fn):
         h = rms_norm(x, lp["ln1"]["scale"], eps)
         q = split_heads(dense(h, lp["attn"]["wq"]), nh)
         k = split_heads(dense(h, lp["attn"]["wk"]), nkv)
         v = split_heads(dense(h, lp["attn"]["wv"]), nkv)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        k_att, v_att = kv_fn(k, v)
-        a = attend(
-            q,
-            repeat_kv(k_att.astype(q.dtype), groups),
-            repeat_kv(v_att.astype(q.dtype), groups),
-            mask,
-        )
+        a = attend_fn(q, k, v)
         x = x + dense(merge_heads(a), lp["attn"]["wo"])
         h2 = rms_norm(x, lp["ln2"]["scale"], eps)
         g = dense(h2, lp["mlp"]["wg"])
@@ -185,57 +190,98 @@ def forward(
         x = x + dense(jax.nn.silu(g) * u, lp["mlp"]["wd"])
         return x
 
+    def full_attend(q, k_att, v_att):
+        return attend(
+            q,
+            repeat_kv(k_att.astype(q.dtype), groups),
+            repeat_kv(v_att.astype(q.dtype), groups),
+            mask,
+        )
+
     if cache is None:
         def body(carry, lp):
-            return block(carry, lp, lambda k, v: (k, v)), None
+            return block(carry, lp, full_attend), None
 
         x, _ = jax.lax.scan(body, x, params["blocks"])
         new_cache = None
     else:
         zero = jnp.zeros((), jnp.int32)
+        fused = cfg.fused_decode_attention and t == 1
+        if cfg.fused_decode_attention and cfg.quant_kv:
+            raise ValueError(
+                "fused_decode_attention and quant_kv are mutually exclusive "
+                "(the pallas kernel reads a full-precision cache)"
+            )
+        quant_kv = cfg.quant_kv
+        bias = attention_ops.mask_to_bias(mask) if fused else None
 
         def body(carry, xs):
-            x, ck, cv = carry
+            x, ck, cv, cks, cvs = carry
             lp, layer = xs
             updated = {}
 
-            def kv_fn(k_new, v_new):
+            def attend_fn(q, k_new, v_new):
+                if quant_kv:
+                    k_w, k_s = quantize_kv(k_new)
+                    v_w, v_s = quantize_kv(v_new)
+                else:
+                    k_w, v_w = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
+                cks2, cvs2 = cks, cvs
                 if offset.ndim == 1:  # ragged slots: scatter at per-row pos
                     rows = jnp.arange(k_new.shape[0])
-                    ck2 = ck.at[layer, rows, :, offset, :].set(
-                        k_new[:, :, 0, :].astype(ck.dtype)
-                    )
-                    cv2 = cv.at[layer, rows, :, offset, :].set(
-                        v_new[:, :, 0, :].astype(cv.dtype)
-                    )
+                    ck2 = ck.at[layer, rows, :, offset, :].set(k_w[:, :, 0, :])
+                    cv2 = cv.at[layer, rows, :, offset, :].set(v_w[:, :, 0, :])
+                    if quant_kv:
+                        cks2 = cks.at[layer, rows, :, offset].set(k_s[:, :, 0])
+                        cvs2 = cvs.at[layer, rows, :, offset].set(v_s[:, :, 0])
                 else:
                     start = (layer, zero, zero, offset, zero)
-                    ck2 = jax.lax.dynamic_update_slice(
-                        ck, k_new.astype(ck.dtype)[None], start
+                    ck2 = jax.lax.dynamic_update_slice(ck, k_w[None], start)
+                    cv2 = jax.lax.dynamic_update_slice(cv, v_w[None], start)
+                    if quant_kv:
+                        s_start = (layer, zero, zero, offset)
+                        cks2 = jax.lax.dynamic_update_slice(
+                            cks, k_s[None], s_start
+                        )
+                        cvs2 = jax.lax.dynamic_update_slice(
+                            cvs, v_s[None], s_start
+                        )
+                updated.update(k=ck2, v=cv2, ks=cks2, vs=cvs2)
+                if fused:
+                    return attention_ops.decode_attention(
+                        q, ck2, cv2, layer, bias
                     )
-                    cv2 = jax.lax.dynamic_update_slice(
-                        cv, v_new.astype(cv.dtype)[None], start
+                k_att = jax.lax.dynamic_index_in_dim(ck2, layer, 0,
+                                                     keepdims=False)
+                v_att = jax.lax.dynamic_index_in_dim(cv2, layer, 0,
+                                                     keepdims=False)
+                if quant_kv:
+                    ks_att = jax.lax.dynamic_index_in_dim(cks2, layer, 0,
+                                                          keepdims=False)
+                    vs_att = jax.lax.dynamic_index_in_dim(cvs2, layer, 0,
+                                                          keepdims=False)
+                    return attend_quant(
+                        q,
+                        repeat_kv(k_att, groups),
+                        jnp.repeat(ks_att, groups, axis=1),
+                        repeat_kv(v_att, groups),
+                        jnp.repeat(vs_att, groups, axis=1),
+                        mask,
                     )
-                updated["k"], updated["v"] = ck2, cv2
-                return (
-                    jax.lax.dynamic_index_in_dim(ck2, layer, 0, keepdims=False),
-                    jax.lax.dynamic_index_in_dim(cv2, layer, 0, keepdims=False),
-                )
+                return full_attend(q, k_att, v_att)
 
-            y = block(x, lp, kv_fn)
-            return (y, updated["k"], updated["v"]), None
+            y = block(x, lp, attend_fn)
+            return (y, updated["k"], updated["v"], updated["ks"],
+                    updated["vs"]), None
 
         layers = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-        (x, new_k, new_v), _ = jax.lax.scan(
-            body, (x, cache.k, cache.v), (params["blocks"], layers)
+        (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
+            body, (x, cache.k, cache.v, cache.ks, cache.vs),
+            (params["blocks"], layers),
         )
-        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + t)
+        new_cache = KVCache(k=new_k, v=new_v, length=cache.length + t,
+                            ks=new_ks, vs=new_vs)
 
     x = rms_norm(x, params["lnf"]["scale"], eps)
-    logits = jnp.einsum(
-        "btd,vd->btv",
-        x,
-        params["lm_head"].astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    )
+    logits = quant.unembed(x, params["lm_head"])
     return logits, new_cache

@@ -1,0 +1,11 @@
+"""Custom TPU (Pallas) kernels for the hot serving ops.
+
+XLA's automatic fusion covers almost everything in this framework; kernels
+live here only where a hand schedule measurably beats it. Current contents:
+
+- `attention.decode_attention` — fused single-token attention for the
+  autoregressive decode loop (q·K^T → masked softmax → ·V in one VMEM
+  pass per layer).
+"""
+
+from .attention import decode_attention, use_fused_decode_attention  # noqa: F401

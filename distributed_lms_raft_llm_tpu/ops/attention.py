@@ -1,0 +1,125 @@
+"""Pallas TPU kernel: fused single-token (decode) attention.
+
+The autoregressive decode step is HBM-bandwidth-bound: every step streams
+the whole KV cache once per layer. XLA compiles `attend`'s einsum chain
+(models/common.py:attend) into separate score and weighted-sum fusions
+with a f32 [B, H, S] intermediate between them; this kernel computes
+q·K^T → masked softmax → ·V in one pass per batch row, so K and V each
+cross HBM exactly once per layer and nothing round-trips in between.
+
+The kernel reads the layer's K/V directly out of the STACKED cache
+([L, B, Hkv, S, Dh], the scan carry) via a scalar-prefetched layer index —
+slicing the layer out first (`dynamic_index_in_dim`) and handing pallas
+the slice costs a 2×[B,Hkv,S,Dh] HBM copy per layer, which measured
+SLOWER than the XLA einsum path it was meant to beat.
+
+Scope: decode only (one query token per row). Prefill and training keep
+the XLA einsum path — there the query dimension is large, the MXU is busy,
+and XLA's tiling is already the right schedule. Grouped-query models pass
+kv_heads < num_heads; the kernel indexes the shared KV head directly, so
+the repeat_kv materialization is skipped too. Capability parity note: the
+reference has no analogue (HF torch `model.generate` on CPU, reference:
+GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29); this file exists purely
+to buy TPU headroom.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_attn_kernel(l_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+                        num_heads: int, kv_heads: int, scale: float):
+    """One batch row: [H, Dh] query against the layer's [Hkv, S, Dh] K/V.
+
+    Heads run as a static loop of 2-D dots — Mosaic does not lower batched
+    dot_general, and per-head [1, Dh] x [Dh, S] products keep everything in
+    VMEM anyway. Scores and softmax accumulate in f32; the weighted sum
+    returns to the cache dtype only at the end.
+    """
+    del l_ref  # consumed by the BlockSpec index maps
+    group = num_heads // kv_heads
+    bias = bias_ref[0]  # [1, S] additive mask: 0 or NEG_INF
+    for h in range(num_heads):
+        qh = q_ref[0, h][None, :]  # [1, Dh]
+        sc = jax.lax.dot_general(
+            qh, k_ref[0, 0, h // group], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [1, S]
+        sc = sc * scale + bias
+        m = jnp.max(sc, axis=-1, keepdims=True)
+        p = jnp.exp(sc - m)
+        denom = jnp.sum(p, axis=-1, keepdims=True)
+        oh = jax.lax.dot_general(
+            p.astype(k_ref.dtype), v_ref[0, 0, h // group],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [1, Dh]
+        o_ref[0, h] = ((oh / denom)[0]).astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     layer: jax.Array, bias: jax.Array) -> jax.Array:
+    """Fused decode attention against one layer of the stacked KV cache.
+
+    q        [B, H, 1, Dh] — the decode step's queries
+    k_cache  [L, B, Hkv, S, Dh] — the scan-carried stacked cache
+    v_cache  [L, B, Hkv, S, Dh]
+    layer    [] int32 — which layer's K/V to attend against
+    bias     [B, 1, S] f32 — additive mask (0 = attend, NEG_INF = not)
+    returns  [B, H, 1, Dh] in q's dtype.
+
+    Call sites gate on platform (`use_fused_decode_attention`); this
+    function assumes a TPU backend.
+    """
+    b, h, t, dh = q.shape
+    _, _, hkv, s, _ = k_cache.shape
+    assert t == 1, "decode_attention handles one query token per row"
+    scale = 1.0 / (dh ** 0.5)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_attn_kernel, num_heads=h, kv_heads=hkv, scale=scale
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, h, dh), lambda i, l: (i, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, hkv, s, dh), lambda i, l: (l[0], i, 0, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, hkv, s, dh), lambda i, l: (l[0], i, 0, 0, 0)
+                ),
+                pl.BlockSpec((1, 1, s), lambda i, l: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, h, dh), lambda i, l: (i, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
+    )(layer[None].astype(jnp.int32), q[:, :, 0, :], k_cache, v_cache, bias)
+    return out[:, :, None, :]
+
+
+def mask_to_bias(mask: jax.Array) -> jax.Array:
+    """[B, 1, T, S] boolean attend-mask -> [B, 1, S] additive f32 bias
+    (layer-invariant: compute once per decode step, outside the layer scan)."""
+    return jnp.where(mask[:, 0, 0, :], 0.0, NEG_INF).astype(jnp.float32)[
+        :, None, :
+    ]
+
+
+def use_fused_decode_attention(q: jax.Array) -> bool:
+    """True when the pallas decode kernel applies: single query token and a
+    TPU backend (CPU tests and golden runs keep the reference einsum path)."""
+    if os.environ.get("DLRL_NO_PALLAS_ATTN"):
+        return False
+    return q.shape[2] == 1 and jax.default_backend() == "tpu"

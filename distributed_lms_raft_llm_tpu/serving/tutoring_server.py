@@ -145,6 +145,15 @@ def main(argv=None) -> None:
     parser.add_argument("--vocab", default=None, help="GPT-2 vocab.json")
     parser.add_argument("--merges", default=None, help="GPT-2 merges.txt")
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument(
+        "--quant", default=None, choices=["int8"],
+        help="weight-only int8 serving (halves the parameter bytes the "
+        "decode loop streams; near-lossless, see tests/test_quant.py)",
+    )
+    parser.add_argument(
+        "--kv-quant", action="store_true",
+        help="int8 KV cache with per-slot scales",
+    )
     parser.add_argument("--max-new-tokens", type=int, default=128)
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--max-wait-ms", type=float, default=10.0)
@@ -184,6 +193,8 @@ def main(argv=None) -> None:
         merges_path=args.merges,
         sampling=sampling,
         tp=args.tp,
+        quant=args.quant,
+        kv_quant=args.kv_quant,
     )
     if args.paged:
         # --max-batch bounds concurrency in both modes: it is the decode
