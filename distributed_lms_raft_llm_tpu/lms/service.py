@@ -16,8 +16,11 @@ fixed:
 - the BERT gate is a long-lived engine object, not a per-request model load
   (D4), and the tutoring channel is dialed once.
 
-Read RPCs serve from the local replica (the client routes them to the
-leader, same as the reference).
+Read RPCs are linearizable by default: each one passes a read fence
+(`raft.RaftNode.read_barrier`) that proves current leadership before the
+local replica is consulted, so a partitioned ex-leader refuses reads
+instead of serving stale state (the reference served whatever the local
+dict held, lms_server.py:1063-1133).
 """
 
 from __future__ import annotations
@@ -56,10 +59,12 @@ class LMSServicer(rpc.LMSServicer):
         metrics: Optional[Metrics] = None,
         peer_addresses: Optional[Dict[int, str]] = None,
         self_id: Optional[int] = None,
+        linearizable_reads: bool = True,
     ):
         self.node = node
         self.state = state
         self.blobs = blobs
+        self.linearizable_reads = linearizable_reads
         self.gate = gate
         self.metrics = metrics or Metrics()
         self._tutoring_address = tutoring_address
@@ -99,6 +104,26 @@ class LMSServicer(rpc.LMSServicer):
                 f"not the leader or no quorum ({e}); re-resolve and retry",
             )
             return False  # unreachable; abort raises
+
+    async def _read_fence(self, context) -> None:
+        """Linearizable reads: confirm leadership before serving local state
+        (raft.RaftNode.read_barrier). A partitioned ex-leader fails the
+        barrier and aborts with UNAVAILABLE — the client re-resolves the
+        real leader instead of reading stale state. Runs BEFORE the session
+        check so the auth lookup itself is linearizable (a session created
+        through the new leader is visible, not spuriously 'invalid').
+        Disabled (`linearizable_reads=False`) reads serve local state
+        directly — the reference's (stale-prone) behavior."""
+        if not self.linearizable_reads:
+            return
+        try:
+            await self.node.read_barrier()
+        except (NotLeader, TimeoutError, RuntimeError) as e:
+            log.info("read fence failed: %s", e)
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"not the leader for reads ({e}); re-resolve and retry",
+            )
 
     def _tutoring(self):
         if self._tutoring_stub is None:
@@ -322,6 +347,7 @@ class LMSServicer(rpc.LMSServicer):
     # ---------------------------------------------------------------- reads
 
     async def Get(self, request, context):
+        await self._read_fence(context)
         auth = self._auth(request.token)
         if auth is None:
             return lms_pb2.GetResponse(success=False)
@@ -364,6 +390,7 @@ class LMSServicer(rpc.LMSServicer):
         )
 
     async def GetGrade(self, request, context):
+        await self._read_fence(context)
         auth = self._auth(request.token)
         if auth is None:
             return lms_pb2.GetGradeResponse(success=False, grade="Invalid session")
@@ -385,6 +412,7 @@ class LMSServicer(rpc.LMSServicer):
         return lms_pb2.GetGradeResponse(success=True, grade="No grade assigned yet.")
 
     async def GetUnansweredQueries(self, request, grpc_context):
+        await self._read_fence(grpc_context)
         auth = self._auth(request.token)
         if auth is None or auth[1] != "instructor":
             return lms_pb2.GetResponse(success=False)
@@ -395,6 +423,7 @@ class LMSServicer(rpc.LMSServicer):
         return lms_pb2.GetResponse(success=True, entries=entries)
 
     async def GetInstructorResponse(self, request, grpc_context):
+        await self._read_fence(grpc_context)
         auth = self._auth(request.token)
         if auth is None or auth[1] != "student":
             return lms_pb2.GetResponse(success=False)
@@ -414,6 +443,7 @@ class LMSServicer(rpc.LMSServicer):
     # ------------------------------------------------------------ LLM path
 
     async def GetLLMAnswer(self, request, context):
+        await self._read_fence(context)
         self.metrics.inc("llm_requests")
         auth = self._auth(request.token)
         if auth is None:

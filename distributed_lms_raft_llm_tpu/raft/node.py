@@ -81,6 +81,7 @@ class RaftNode:
         # otherwise a new leader's different entry at the same index would be
         # mistaken for our commit.
         self._commit_waiters: Dict[int, List[Tuple[int, asyncio.Future]]] = {}
+        self._read_barrier: Optional[asyncio.Future] = None
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
 
@@ -128,6 +129,31 @@ class RaftNode:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             raise TimeoutError(f"entry {index} not committed within {timeout}s")
+
+    async def read_barrier(self, timeout: float = 10.0) -> int:
+        """Linearizable read fence: resolves once this node has PROVEN it is
+        still the leader by committing an entry of its current term, with the
+        state machine applied through that point.
+
+        Implementation: propose a no-op and await its quorum commit (the
+        log-barrier read — the wire-compatible alternative to a read-index
+        round, since the frozen AppendEntries contract has no field to
+        correlate a heartbeat round with). A deposed leader cannot commit in
+        its term, so its reads fail (NotLeader/Timeout) instead of serving
+        stale state; by the time the barrier resolves every prior committed
+        entry has passed through apply_cb (commit waiters resolve in apply
+        order). Concurrent readers coalesce onto one in-flight barrier, so a
+        read burst costs one log entry, not one per read.
+        """
+        if self.core.role is not Role.LEADER:
+            raise NotLeader(self.core.leader_id)
+        if self._read_barrier is None or self._read_barrier.done():
+            self._read_barrier = asyncio.ensure_future(
+                self.propose(NOOP, timeout=timeout)
+            )
+        # shield: one cancelled reader (client gone) must not cancel the
+        # barrier other coalesced readers are waiting on.
+        return await asyncio.shield(self._read_barrier)
 
     # RPC entry points (called by the gRPC servicer / mem transport) ------
 

@@ -1,0 +1,163 @@
+"""Linearizable reads: the read barrier refuses stale-leader reads.
+
+The reference serves every read from whichever node the client reached
+(reference: GUI_RAFT_LLM_SourceCode/lms_server.py:1063-1133) — after a
+partition, a deposed leader happily answers from stale state. Here every
+read RPC passes `RaftNode.read_barrier()` (a no-op commit fence) first:
+the deposed leader cannot commit in its term, so the read fails over
+instead of lying.
+"""
+
+import asyncio
+
+import pytest
+
+from distributed_lms_raft_llm_tpu.raft import (
+    MemNetwork,
+    MemoryStorage,
+    NotLeader,
+    RaftConfig,
+    RaftNode,
+    encode_command,
+)
+
+from test_raft_cluster import FAST, build_cluster, wait_for_leader
+
+
+def test_read_barrier_resolves_on_healthy_leader():
+    async def run():
+        net = MemNetwork()
+        applied = {}
+        nodes, _ = build_cluster(net, 3, applied=applied)
+        for n in nodes.values():
+            await n.start()
+        leader = await wait_for_leader(nodes)
+        await leader.propose(encode_command("set", {"k": 1}))
+        index = await asyncio.wait_for(leader.read_barrier(), 3.0)
+        # The barrier point covers the write: the entry is applied locally
+        # by the time the fence resolves.
+        assert any(i <= index for i, _ in applied[leader.node_id])
+        for n in nodes.values():
+            await n.stop()
+
+    asyncio.run(run())
+
+
+def test_read_barrier_coalesces_concurrent_readers():
+    async def run():
+        net = MemNetwork()
+        nodes, _ = build_cluster(net, 3)
+        for n in nodes.values():
+            await n.start()
+        leader = await wait_for_leader(nodes)
+        base = leader.core.last_log_index
+        results = await asyncio.gather(
+            *[leader.read_barrier() for _ in range(8)]
+        )
+        # One barrier no-op served the whole burst (one log entry, maybe
+        # two if a tick raced in — never eight).
+        assert leader.core.last_log_index - base <= 2
+        assert all(r >= base for r in results)
+        for n in nodes.values():
+            await n.stop()
+
+    asyncio.run(run())
+
+
+def test_deposed_leader_refuses_reads_new_leader_serves():
+    """The VERDICT done-criterion: partition the leader away, let the
+    majority elect a successor and commit new writes; the old leader's
+    read barrier must fail (no quorum / stepped down) while the new
+    leader's resolves and covers the new writes."""
+
+    async def run():
+        net = MemNetwork()
+        applied = {}
+        nodes, _ = build_cluster(net, 3, applied=applied)
+        for n in nodes.values():
+            await n.start()
+        old = await wait_for_leader(nodes)
+        await old.propose(encode_command("set", {"k": "old"}))
+
+        # Cut the leader off from the majority.
+        minority = {old.node_id}
+        majority = set(nodes) - minority
+        net.partition(minority, majority)
+
+        # Majority elects a successor and commits a write the old leader
+        # never sees.
+        new = await wait_for_leader(
+            {i: nodes[i] for i in majority}, timeout=5.0
+        )
+        await new.propose(encode_command("set", {"k": "new"}))
+
+        # Old leader: barrier cannot commit. Depending on timing it either
+        # still thinks it leads (timeout: no quorum) or has stepped down
+        # after its election timeout (NotLeader) — both REFUSE the read.
+        with pytest.raises((NotLeader, TimeoutError)):
+            await old.read_barrier(timeout=0.8)
+
+        # New leader: barrier resolves, and its barrier point covers the
+        # post-partition write (applied before the fence resolved).
+        index = await asyncio.wait_for(new.read_barrier(), 3.0)
+        cmds = [c for _, c in applied[new.node_id]]
+        assert encode_command("set", {"k": "new"}) in cmds
+        assert index >= max(i for i, _ in applied[new.node_id])
+
+        # Heal: the old leader rejoins, steps down, and can serve again
+        # through the new leader's replication.
+        net.heal()
+        await asyncio.sleep(0.6)
+        assert not old.is_leader
+        for n in nodes.values():
+            await n.stop()
+
+    asyncio.run(run())
+
+
+def test_service_read_fence_refuses_on_follower():
+    """Service-level: a GetGrade against a node whose barrier fails aborts
+    with UNAVAILABLE (the client's retry path re-resolves the leader)."""
+    import grpc
+
+    from distributed_lms_raft_llm_tpu.lms.persistence import BlobStore
+    from distributed_lms_raft_llm_tpu.lms.service import LMSServicer
+    from distributed_lms_raft_llm_tpu.lms.state import LMSState
+
+    class AbortCalled(Exception):
+        pass
+
+    class FakeContext:
+        def __init__(self):
+            self.code = None
+
+        async def abort(self, code, details):
+            self.code = code
+            raise AbortCalled(details)
+
+    async def run(tmp):
+        net = MemNetwork()
+        nodes, _ = build_cluster(net, 3)
+        for n in nodes.values():
+            await n.start()
+        leader = await wait_for_leader(nodes)
+        follower = next(
+            n for n in nodes.values() if n.node_id != leader.node_id
+        )
+        svc = LMSServicer(
+            follower, LMSState(), BlobStore(str(tmp / "blobs"))
+        )
+
+        class Req:
+            token = "whatever"
+
+        ctx = FakeContext()
+        with pytest.raises(AbortCalled):
+            await svc.GetGrade(Req(), ctx)
+        assert ctx.code == grpc.StatusCode.UNAVAILABLE
+        for n in nodes.values():
+            await n.stop()
+
+    import tempfile, pathlib
+    with tempfile.TemporaryDirectory() as d:
+        asyncio.run(run(pathlib.Path(d)))
