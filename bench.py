@@ -171,7 +171,18 @@ def main() -> None:
                     help="BASELINE config to bench (default: the headline)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel ways (config 4: gpt2-large tp)")
+    ap.add_argument("--config", default=None,
+                    help="TOML deployment file; [tutoring] model/tp apply")
     args = ap.parse_args()
+    if args.config:
+        from distributed_lms_raft_llm_tpu.config import load_config
+
+        t = load_config(args.config).tutoring
+        if args.model == "gpt2" and t.model in ("gpt2", "gpt2-medium",
+                                                "gpt2-large"):
+            args.model = t.model
+        if args.tp == 1:
+            args.tp = t.tp
     quant = bench_tpu(args.model, args.tp, quant=True) if args.tp == 1 else None
     tpu = bench_tpu(args.model, args.tp)
     baseline_tps = bench_torch_baseline(args.model)

@@ -502,8 +502,16 @@ def main(argv=None) -> None:
                 "127.0.0.1:50055,127.0.0.1:50056",
         help="comma-separated LMS server addresses",
     )
+    parser.add_argument("--config", default=None,
+                        help="TOML deployment file; [cluster.nodes] supplies "
+                             "the server list")
     args = parser.parse_args(argv)
-    client = LMSClient(args.servers.split(","))
+    servers = args.servers.split(",")
+    if args.config:
+        from ..config import load_config
+
+        servers = load_config(args.config).client_servers
+    client = LMSClient(servers)
     try:
         client.discover_leader()
     except NoLeader as e:

@@ -1,0 +1,173 @@
+"""One declarative config for the whole deployment (TOML).
+
+The reference configures by editing source: hardcoded server address maps
+(reference: GUI_RAFT_LLM_SourceCode/lms_server.py:1454-1460), a hardcoded
+tutoring IP (:39), client address lists (lms_gui_final.py:23-29), sampling
+constants (tutoring_server.py:22-28), and the 0.6 gate threshold (:1267) —
+README.md:101-102 literally instructs editing the files. Here one TOML file
+describes the cluster topology, Raft timing, tutoring engine (model /
+checkpoint / mesh / quantization / sampling), BERT gate, and client, and
+every entrypoint consumes it:
+
+    python -m ...serving.lms_server --config cluster.toml --id 3
+    python -m ...serving.tutoring_server --config cluster.toml
+    python -m ...client.cli --config cluster.toml
+    python bench.py --config cluster.toml
+
+CLI flags still work and override file values (two-phase parse: the file
+fills argparse defaults, explicit flags win). See configs/cluster.toml for
+a full reference-topology example.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """[cluster] — the 5-node Raft topology and its timing."""
+
+    nodes: Dict[int, str] = dataclasses.field(default_factory=dict)
+    data_dir: str = "lms_data"  # per-node state under <data_dir>/node<id>
+    election_timeout: float = 0.5
+    heartbeat_interval: float = 0.1
+    snapshot_every: int = 64
+    metrics_period: float = 60.0
+    linearizable_reads: bool = True
+
+    @property
+    def addresses(self) -> Dict[int, str]:
+        return dict(self.nodes)
+
+
+@dataclasses.dataclass
+class SamplingConfig:
+    """[sampling] — reference defaults (tutoring_server.py:22-28)."""
+
+    temperature: float = 0.7
+    top_k: int = 50
+    top_p: float = 0.9
+    repetition_penalty: float = 1.2
+    max_new_tokens: int = 128
+
+
+@dataclasses.dataclass
+class TutoringConfig:
+    """[tutoring] — the TPU inference node."""
+
+    address: str = "127.0.0.1:50054"
+    model: str = "gpt2"
+    checkpoint: Optional[str] = None
+    vocab: Optional[str] = None
+    merges: Optional[str] = None
+    tokenizer_json: Optional[str] = None
+    tp: int = 1
+    quant: Optional[str] = None  # "int8" = weight-only int8
+    kv_quant: bool = False
+    paged: bool = False          # continuous batching
+    max_batch: int = 8
+    max_wait_ms: float = 10.0
+    slots: Optional[int] = None
+    auth_key_file: Optional[str] = None
+
+    @property
+    def port(self) -> int:
+        return int(self.address.rsplit(":", 1)[1])
+
+
+@dataclasses.dataclass
+class GateConfig:
+    """[gate] — the BERT relevance gate on the LMS leader."""
+
+    model: Optional[str] = None  # e.g. "bert-base-uncased" | "tiny"; None = off
+    checkpoint: Optional[str] = None
+    vocab: Optional[str] = None
+    threshold: float = 0.6       # reference: lms_server.py:1267
+
+
+@dataclasses.dataclass
+class AppConfig:
+    cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
+    tutoring: TutoringConfig = dataclasses.field(default_factory=TutoringConfig)
+    sampling: SamplingConfig = dataclasses.field(default_factory=SamplingConfig)
+    gate: GateConfig = dataclasses.field(default_factory=GateConfig)
+
+    @property
+    def client_servers(self) -> List[str]:
+        return [self.cluster.nodes[k] for k in sorted(self.cluster.nodes)]
+
+
+def _build(cls, table: Dict[str, Any], path: str):
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(table) - set(fields)
+    if unknown:
+        raise ValueError(
+            f"unknown key(s) {sorted(unknown)} in [{path}] "
+            f"(known: {sorted(fields)})"
+        )
+    return cls(**table)
+
+
+def load_config(path: str) -> AppConfig:
+    """Parse a TOML deployment file into an AppConfig (strict keys)."""
+    with open(path, "rb") as fh:
+        raw = tomllib.load(fh)
+    unknown = set(raw) - {"cluster", "tutoring", "sampling", "gate"}
+    if unknown:
+        raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
+
+    cluster_tbl = dict(raw.get("cluster", {}))
+    # TOML keys are strings; node ids are ints.
+    if "nodes" in cluster_tbl:
+        cluster_tbl["nodes"] = {
+            int(k): str(v) for k, v in cluster_tbl["nodes"].items()
+        }
+    return AppConfig(
+        cluster=_build(ClusterConfig, cluster_tbl, "cluster"),
+        tutoring=_build(TutoringConfig, dict(raw.get("tutoring", {})),
+                        "tutoring"),
+        sampling=_build(SamplingConfig, dict(raw.get("sampling", {})),
+                        "sampling"),
+        gate=_build(GateConfig, dict(raw.get("gate", {})), "gate"),
+    )
+
+
+# --------------------------------------------------- entrypoint adapters
+
+
+def sampling_params(cfg: AppConfig):
+    from .engine import SamplingParams
+
+    s = cfg.sampling
+    return SamplingParams(
+        temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+        repetition_penalty=s.repetition_penalty,
+        max_new_tokens=s.max_new_tokens,
+    )
+
+
+def engine_config(cfg: AppConfig):
+    """EngineConfig for the tutoring node described by [tutoring]+[sampling]."""
+    from .engine import EngineConfig
+
+    t = cfg.tutoring
+    return EngineConfig(
+        model=t.model, checkpoint=t.checkpoint, vocab_path=t.vocab,
+        merges_path=t.merges, tokenizer_json=t.tokenizer_json,
+        sampling=sampling_params(cfg), tp=t.tp, quant=t.quant,
+        kv_quant=t.kv_quant,
+    )
+
+
+def raft_config(cfg: AppConfig):
+    from .raft import RaftConfig
+
+    c = cfg.cluster
+    return RaftConfig(
+        election_timeout_min=c.election_timeout / 2,
+        election_timeout_max=c.election_timeout,
+        heartbeat_interval=c.heartbeat_interval,
+    )

@@ -12,6 +12,11 @@ Run (5-node cluster, reference topology):
 
 Peers are listed as ports (same-host dev) or full host:port addresses,
 node ids 1..N in order. --tutoring points at the TPU tutoring node.
+
+Or declaratively — one TOML for the whole deployment (config.py):
+    python -m distributed_lms_raft_llm_tpu.serving.lms_server \
+        --config configs/cluster.toml --id 1
+Explicit CLI flags override file values.
 """
 
 from __future__ import annotations
@@ -20,6 +25,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 from typing import Dict
 
 import grpc
@@ -62,7 +68,8 @@ async def serve_async(args) -> None:
 
         gate = RelevanceGate(
             GateConfig(model=args.gate_model, checkpoint=args.gate_checkpoint,
-                       vocab_path=args.gate_vocab)
+                       vocab_path=args.gate_vocab,
+                       threshold=args.gate_threshold)
         )
         gate.warmup()
 
@@ -82,6 +89,7 @@ async def serve_async(args) -> None:
         metrics=metrics,
         peer_addresses=addresses,
         self_id=args.id,
+        linearizable_reads=args.linearizable_reads,
     )
     server = grpc.aio.server(
         options=[
@@ -118,10 +126,17 @@ async def serve_async(args) -> None:
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("id", type=int, help="node id (1-based)")
-    parser.add_argument("port", type=int, help="port to serve on")
-    parser.add_argument("peers", nargs="+",
+    parser.add_argument("id", type=int, nargs="?", default=None,
+                        help="node id (1-based)")
+    parser.add_argument("port", type=int, nargs="?", default=None,
+                        help="port to serve on")
+    parser.add_argument("peers", nargs="*",
                         help="cluster peer ports or host:port, ids 1..N")
+    parser.add_argument("--config", default=None,
+                        help="TOML deployment file (config.py); use with "
+                             "--id instead of positionals")
+    parser.add_argument("--id", type=int, dest="id_flag", default=None,
+                        help="node id when using --config")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--data-dir", default=None,
                         help="state directory (default ./lms_node_<id>)")
@@ -135,17 +150,64 @@ def main(argv=None) -> None:
                              "'tiny'); omit to disable the gate")
     parser.add_argument("--gate-checkpoint", default=None)
     parser.add_argument("--gate-vocab", default=None)
+    parser.add_argument("--gate-threshold", type=float, default=0.6)
     parser.add_argument("--election-timeout", type=float, default=0.5)
     parser.add_argument("--heartbeat-interval", type=float, default=0.1)
     parser.add_argument("--metrics-period", type=float, default=60.0)
     parser.add_argument("--snapshot-every", type=int, default=64,
                         help="full-state snapshot cadence in applied commands")
+    parser.add_argument("--no-linearizable-reads", action="store_true",
+                        help="serve reads from local state without the "
+                             "leadership fence (the reference's behavior)")
     parser.add_argument(
         "--jax-platform", default="cpu", choices=["cpu", "default"],
         help="device for the in-process BERT gate; 'cpu' (default) keeps "
              "control-plane nodes off the TPU so the tutoring node owns it",
     )
     args = parser.parse_args(argv)
+    args.linearizable_reads = not args.no_linearizable_reads
+    if args.config:
+        from ..config import load_config
+
+        cfg = load_config(args.config)
+        args.id = args.id_flag if args.id_flag is not None else args.id
+        if args.id is None:
+            parser.error("--config requires --id <node id>")
+        if args.id not in cfg.cluster.nodes:
+            parser.error(f"node id {args.id} not in [cluster.nodes]")
+        # File fills everything the CLI left at its default; explicit
+        # flags (compared against parser defaults) win.
+        d = parser.get_default
+        args.peers = [cfg.cluster.nodes[k] for k in sorted(cfg.cluster.nodes)]
+        args.port = int(cfg.cluster.nodes[args.id].rsplit(":", 1)[1])
+        if args.data_dir == d("data_dir"):
+            args.data_dir = os.path.join(cfg.cluster.data_dir,
+                                         f"node{args.id}")
+        if args.tutoring == d("tutoring"):
+            args.tutoring = cfg.tutoring.address
+        if args.tutoring_auth_key_file == d("tutoring_auth_key_file"):
+            args.tutoring_auth_key_file = cfg.tutoring.auth_key_file
+        if args.gate_model == d("gate_model"):
+            args.gate_model = cfg.gate.model
+        if args.gate_checkpoint == d("gate_checkpoint"):
+            args.gate_checkpoint = cfg.gate.checkpoint
+        if args.gate_vocab == d("gate_vocab"):
+            args.gate_vocab = cfg.gate.vocab
+        if args.gate_threshold == d("gate_threshold"):
+            args.gate_threshold = cfg.gate.threshold
+        if args.election_timeout == d("election_timeout"):
+            args.election_timeout = cfg.cluster.election_timeout
+        if args.heartbeat_interval == d("heartbeat_interval"):
+            args.heartbeat_interval = cfg.cluster.heartbeat_interval
+        if args.metrics_period == d("metrics_period"):
+            args.metrics_period = cfg.cluster.metrics_period
+        if args.snapshot_every == d("snapshot_every"):
+            args.snapshot_every = cfg.cluster.snapshot_every
+        if not args.no_linearizable_reads:
+            args.linearizable_reads = cfg.cluster.linearizable_reads
+    elif args.id is None or args.port is None or not args.peers:
+        parser.error("need either positional <id> <port> <peers...> or "
+                     "--config <file> --id <node id>")
     if args.data_dir is None:
         args.data_dir = f"lms_node_{args.id}"
     if args.jax_platform == "cpu":

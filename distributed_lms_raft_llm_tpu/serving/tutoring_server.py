@@ -138,6 +138,9 @@ async def serve_async(
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", default=None,
+                        help="TOML deployment file (config.py [tutoring] + "
+                             "[sampling]); explicit flags override it")
     parser.add_argument("--port", type=int, default=50054)
     parser.add_argument("--model", default="gpt2")
     parser.add_argument("--checkpoint", default=None,
@@ -176,6 +179,32 @@ def main(argv=None) -> None:
         help="'cpu' for CPU-only runs (tests/dev); default uses the TPU",
     )
     args = parser.parse_args(argv)
+    if args.config:
+        from ..config import load_config
+
+        cfg = load_config(args.config)
+        t, s = cfg.tutoring, cfg.sampling
+        d = parser.get_default
+        overrides = {
+            "port": t.port, "model": t.model, "checkpoint": t.checkpoint,
+            "vocab": t.vocab, "merges": t.merges, "tp": t.tp,
+            "quant": t.quant, "max_new_tokens": s.max_new_tokens,
+            "max_batch": t.max_batch, "max_wait_ms": t.max_wait_ms,
+            "slots": t.slots, "auth_key_file": t.auth_key_file,
+        }
+        for name, value in overrides.items():
+            if getattr(args, name) == d(name):
+                setattr(args, name, value)
+        if not args.kv_quant:
+            args.kv_quant = t.kv_quant
+        if not args.paged:
+            args.paged = t.paged
+        args.sampling_overrides = dict(
+            temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+            repetition_penalty=s.repetition_penalty,
+        )
+    else:
+        args.sampling_overrides = {}
     if args.jax_platform == "cpu":
         import jax
 
@@ -185,7 +214,9 @@ def main(argv=None) -> None:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    sampling = SamplingParams.reference_defaults(max_new_tokens=args.max_new_tokens)
+    sampling = SamplingParams.reference_defaults(
+        max_new_tokens=args.max_new_tokens, **args.sampling_overrides
+    )
     config = EngineConfig(
         model=args.model,
         checkpoint=args.checkpoint,
