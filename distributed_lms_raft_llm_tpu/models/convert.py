@@ -94,6 +94,41 @@ def gpt2_params_from_hf(sd: StateDict, cfg: GPT2Config) -> Dict[str, Any]:
     }
 
 
+def gpt2_params_to_hf(params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Inverse of `gpt2_params_from_hf`: unstack the layer axis back into
+    HF GPT2Model names (`transformer.`-less, the layout our own loader and
+    HF's `from_pretrained` both accept). Lets a fine-tuned model
+    (train/checkpoint.export_model) serve through the standard checkpoint
+    path."""
+    blocks = params["blocks"]
+    L = np.asarray(blocks["ln1"]["scale"]).shape[0]
+    out: Dict[str, np.ndarray] = {
+        "wte.weight": _np(params["wte"]),
+        "wpe.weight": _np(params["wpe"]),
+        "ln_f.weight": _np(params["lnf"]["scale"]),
+        "ln_f.bias": _np(params["lnf"]["bias"]),
+    }
+    per_layer = {
+        "h.{}.ln_1.weight": blocks["ln1"]["scale"],
+        "h.{}.ln_1.bias": blocks["ln1"]["bias"],
+        "h.{}.attn.c_attn.weight": blocks["attn"]["wqkv"],
+        "h.{}.attn.c_attn.bias": blocks["attn"]["bqkv"],
+        "h.{}.attn.c_proj.weight": blocks["attn"]["wo"],
+        "h.{}.attn.c_proj.bias": blocks["attn"]["bo"],
+        "h.{}.ln_2.weight": blocks["ln2"]["scale"],
+        "h.{}.ln_2.bias": blocks["ln2"]["bias"],
+        "h.{}.mlp.c_fc.weight": blocks["mlp"]["wi"],
+        "h.{}.mlp.c_fc.bias": blocks["mlp"]["bi"],
+        "h.{}.mlp.c_proj.weight": blocks["mlp"]["wo"],
+        "h.{}.mlp.c_proj.bias": blocks["mlp"]["bo"],
+    }
+    for fmt, stacked in per_layer.items():
+        arr = _np(stacked)
+        for i in range(L):
+            out[fmt.format(i)] = arr[i]
+    return out
+
+
 def llama_config_from_hf(hf_config: Mapping[str, Any], **kw) -> LlamaConfig:
     return LlamaConfig(
         vocab_size=hf_config["vocab_size"],

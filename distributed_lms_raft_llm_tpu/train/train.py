@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -29,6 +30,7 @@ class TrainConfig:
     learning_rate: float = 3e-4
     weight_decay: float = 0.01
     warmup_steps: int = 100
+    decay_steps: int = 10_000  # cosine horizon; set to the planned run length
     max_grad_norm: float = 1.0
     remat: bool = True  # rematerialize block activations (HBM for FLOPs)
 
@@ -38,7 +40,7 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         init_value=0.0,
         peak_value=cfg.learning_rate,
         warmup_steps=cfg.warmup_steps,
-        decay_steps=10_000,
+        decay_steps=cfg.decay_steps,
     )
     return optax.chain(
         optax.clip_by_global_norm(cfg.max_grad_norm),
@@ -169,3 +171,136 @@ def make_sharded_train_step(
         donate_argnums=(0,),
     )
     return step, state, batch_sharding
+
+
+# ------------------------------------------------------------------ driver
+
+
+def fit(
+    mesh: Mesh,
+    model_cfg: gpt2.GPT2Config,
+    train_cfg: TrainConfig,
+    dataset,                      # train.data.PackedDataset
+    *,
+    epochs: int = 1,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 50,
+    seed: int = 0,
+    log_every: int = 10,
+) -> Dict[str, Any]:
+    """Fine-tune on course data with periodic checkpointing and resume.
+
+    If `checkpoint_path` exists, training RESUMES from it: the full state
+    (params, optimizer moments, step) restores through the run's shardings
+    and the data order continues from the recorded step, so an interrupted
+    run and an uninterrupted one walk the same step sequence.
+    Returns the final (host-fetched) metrics + state handle.
+    """
+    import logging
+
+    from . import checkpoint as ckpt_lib
+
+    log = logging.getLogger("train")
+    step_fn, state, batch_sharding = make_sharded_train_step(
+        mesh, model_cfg, train_cfg, jax.random.key(seed)
+    )
+    if checkpoint_path and ckpt_lib.latest_step(checkpoint_path) is not None:
+        template = jax.tree.map(np.asarray, jax.device_get(state))
+        state = ckpt_lib.restore_train_state(
+            checkpoint_path, template,
+            shardings=train_state_shardings(template, mesh),
+        )
+        log.info("resumed from %s at step %d", checkpoint_path,
+                 int(jax.device_get(state["step"])))
+
+    start_step = int(jax.device_get(state["step"]))
+    steps_per_epoch = dataset.steps_per_epoch()
+    metrics_host: Dict[str, float] = {}
+    step_no = start_step
+    for epoch in range(epochs):
+        for i, batch in enumerate(dataset.batches(epoch)):
+            # Resume: skip batches the restored run already consumed.
+            if epoch * steps_per_epoch + i < start_step:
+                continue
+            batch = {
+                k: jax.device_put(v, batch_sharding[k])
+                for k, v in batch.items()
+            }
+            state, metrics = step_fn(state, batch)
+            step_no += 1
+            if step_no % log_every == 0 or step_no == start_step + 1:
+                metrics_host = {
+                    k: float(jax.device_get(v)) for k, v in metrics.items()
+                }
+                log.info("step %d loss %.4f gnorm %.3f", step_no,
+                         metrics_host["loss"], metrics_host["grad_norm"])
+            if checkpoint_path and step_no % checkpoint_every == 0:
+                ckpt_lib.save_train_state(checkpoint_path, state)
+    if checkpoint_path:
+        ckpt_lib.save_train_state(checkpoint_path, state)
+    if not metrics_host:
+        metrics_host = {"loss": float("nan"), "grad_norm": float("nan")}
+    return {"state": state, "metrics": metrics_host, "step": step_no}
+
+
+def main(argv=None) -> None:
+    """CLI: fine-tune the tutoring model on course materials.
+
+    python -m distributed_lms_raft_llm_tpu.train.train \
+        --data lms_data/node1/uploads --vocab data/gpt2-local/vocab.json \
+        --merges data/gpt2-local/merges.txt --model tiny \
+        --checkpoint ckpt/train_state.safetensors --epochs 2
+    """
+    import argparse
+    import logging
+
+    from ..models import registry
+    from ..parallel import mesh as mesh_lib
+    from ..utils import tokenizer as tok_lib
+    from . import checkpoint as ckpt_lib
+    from .data import DataConfig, PackedDataset
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--data", nargs="+", required=True,
+                        help="course-text files/dirs (.txt/.md/.pdf)")
+    parser.add_argument("--model", default="gpt2")
+    parser.add_argument("--vocab", default=None)
+    parser.add_argument("--merges", default=None)
+    parser.add_argument("--checkpoint", default=None,
+                        help="train-state .safetensors (resume if present)")
+    parser.add_argument("--export", default=None,
+                        help="write fine-tuned params here when done")
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=3e-4)
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--checkpoint-every", type=int, default=50)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    _, model_cfg = registry.resolve(args.model, jnp.bfloat16, jnp.float32)
+    tokenizer = tok_lib.load_gpt2_tokenizer(args.vocab, args.merges, None)
+    dataset = PackedDataset.from_paths(
+        args.data, tokenizer,
+        DataConfig(batch_size=args.batch_size, seq_len=args.seq_len),
+    )
+    mesh = mesh_lib.make_mesh({"tp": args.tp, "dp": -1})
+    steps = args.epochs * dataset.steps_per_epoch()
+    train_cfg = TrainConfig(
+        learning_rate=args.lr,
+        warmup_steps=max(1, steps // 20),
+        decay_steps=max(2, steps),
+    )
+    result = fit(
+        mesh, model_cfg, train_cfg, dataset, epochs=args.epochs,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
+    )
+    if args.export:
+        ckpt_lib.export_model(args.export, result["state"])
+    print(f"trained to step {result['step']}: {result['metrics']}")
+
+
+if __name__ == "__main__":
+    main()
