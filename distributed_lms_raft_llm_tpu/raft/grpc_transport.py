@@ -235,7 +235,3 @@ class RaftServicer(rpc.RaftServiceServicer):
         if request.key in self.kv:
             return lms_pb2.GetValResponse(verdict=True, value=self.kv[request.key])
         return lms_pb2.GetValResponse(verdict=False, value="")
-
-    def apply_kv(self, args: dict) -> None:
-        """Apply callback hook for committed SetVal commands."""
-        self.kv[args["key"]] = args["value"]

@@ -108,6 +108,23 @@ async def serve_async(args) -> None:
     server.add_insecure_port(f"[::]:{args.port}")
     await server.start()
     await lms_node.start()
+    health = None
+    if args.metrics_port is not None:
+        from ..utils.healthz import HealthServer
+
+        health = HealthServer(
+            metrics,
+            health=lambda: {
+                "ok": True,
+                "node_id": args.id,
+                "role": "leader" if lms_node.node.is_leader else "follower",
+                "leader_id": lms_node.node.leader_id,
+                "applied_index": lms_node.node.core.last_applied,
+            },
+            port=args.metrics_port,
+        )
+        bound = await health.start()
+        log.info("health/metrics endpoint on http://127.0.0.1:%d", bound)
     log.info("LMS node %d serving on %d (peers: %s)", args.id, args.port,
              addresses)
 
@@ -121,6 +138,8 @@ async def serve_async(args) -> None:
         await server.wait_for_termination()
     finally:
         reporter.cancel()
+        if health is not None:
+            await health.stop()
         await lms_node.stop()
 
 
@@ -156,6 +175,9 @@ def main(argv=None) -> None:
     parser.add_argument("--metrics-period", type=float, default=60.0)
     parser.add_argument("--snapshot-every", type=int, default=64,
                         help="full-state snapshot cadence in applied commands")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="HTTP /healthz + /metrics endpoint (0 = "
+                             "ephemeral); omit to disable")
     parser.add_argument("--no-linearizable-reads", action="store_true",
                         help="serve reads from local state without the "
                              "leadership fence (the reference's behavior)")

@@ -100,6 +100,7 @@ async def serve_async(
     metrics: Optional[Metrics] = None,
     metrics_period_s: float = 60.0,
     auth_key: Optional[str] = None,
+    metrics_port: Optional[int] = None,
 ) -> grpc.aio.Server:
     """Start (and return) the aio server; caller awaits termination.
 
@@ -123,7 +124,7 @@ async def serve_async(
     rpc.add_TutoringServicer_to_server(
         TutoringService(queue, metrics, auth_key=auth_key), server
     )
-    server.add_insecure_port(f"[::]:{port}")
+    server._port = server.add_insecure_port(f"[::]:{port}")
     await server.start()
     # Keep strong references (asyncio tasks are weakly held by the loop) and
     # expose them for shutdown: callers should cancel _metrics_task and await
@@ -132,6 +133,17 @@ async def serve_async(
         _report_metrics(metrics, metrics_period_s)
     )
     server._queue = queue
+    server._health = None
+    if metrics_port is not None:
+        from ..utils.healthz import HealthServer
+
+        server._health = HealthServer(
+            metrics,
+            health=lambda: {"ok": True, "engine": type(engine).__name__},
+            port=metrics_port,
+        )
+        bound = await server._health.start()
+        log.info("health/metrics endpoint on http://127.0.0.1:%d", bound)
     log.info("tutoring server listening on %d", port)
     return server
 
@@ -168,6 +180,9 @@ def main(argv=None) -> None:
     parser.add_argument("--slots", type=int, default=None,
                         help="paged engine decode slots (default: max batch "
                         "bucket)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="HTTP /healthz + /metrics endpoint (0 = "
+                             "ephemeral); omit to disable")
     parser.add_argument("--no-warmup", action="store_true")
     parser.add_argument(
         "--auth-key-file", default=None,
@@ -247,6 +262,7 @@ def main(argv=None) -> None:
         server = await serve_async(
             args.port, engine, max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, auth_key=auth_key,
+            metrics_port=args.metrics_port,
         )
         await server.wait_for_termination()
 

@@ -1,0 +1,93 @@
+"""Minimal HTTP health/metrics endpoint (stdlib asyncio, no deps).
+
+The reference's only "health" signal is the WhoIsLeader RPC, and metrics
+lived in periodic log lines. This exposes the same Metrics snapshot and a
+liveness/role summary over plain HTTP so operators (and the bench harness)
+can scrape without a gRPC client:
+
+    GET /healthz  -> {"ok": true, "role": "leader", ...}
+    GET /metrics  -> the Metrics.snapshot() JSON
+
+Serving is a ~60-line asyncio protocol rather than http.server-in-a-thread
+so it shares the node's event loop (single-threaded by construction, like
+the Raft runner).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Awaitable, Callable, Dict, Optional
+
+from .metrics import Metrics
+
+Provider = Callable[[], Dict]
+
+
+class HealthServer:
+    def __init__(
+        self,
+        metrics: Metrics,
+        *,
+        health: Optional[Provider] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.metrics = metrics
+        self.health = health or (lambda: {"ok": True})
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> int:
+        """Bind and serve; returns the bound port (for port=0)."""
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 5.0)
+            parts = request_line.decode("latin-1").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            # Drain headers (ignore content: GET only).
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if path == "/healthz":
+                body, status = json.dumps(self.health()), 200
+            elif path == "/metrics":
+                body, status = json.dumps(self.metrics.snapshot()), 200
+            else:
+                body, status = json.dumps({"error": "not found"}), 404
+            payload = body.encode()
+            writer.write(
+                (
+                    f"HTTP/1.1 {status} "
+                    f"{'OK' if status == 200 else 'Not Found'}\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                + payload
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except ConnectionError:
+                pass

@@ -1,0 +1,146 @@
+"""Server-level bench: concurrent gRPC clients against the tutoring server.
+
+BASELINE's TTFT metric is per student query UNDER CONCURRENCY, through the
+real serving stack (gRPC -> queue -> engine), not an idle-engine
+measurement. This boots the tutoring server in-process (same serve_async
+the CLI uses), fires N concurrent clients x M queries each over real gRPC,
+and reports the p50/p95 TTFT from the server's own histogram plus
+end-to-end answer latency and aggregate throughput.
+
+    python scripts/bench_server.py [--clients 8] [--queries 4] [--paged]
+                                   [--quant int8] [--kv-quant]
+
+Prints ONE JSON line (same contract as bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUESTIONS = [
+    "How does Raft consensus elect a leader after a network partition?",
+    "Explain the difference between eventual and linearizable consistency.",
+    "Why does two-phase commit block when the coordinator fails?",
+    "What does the MXU on a TPU actually multiply?",
+    "How does a KV cache speed up autoregressive decoding?",
+    "When should a distributed system prefer leases over locks?",
+    "What is the purpose of a write-ahead log in a database?",
+    "How does gRPC multiplex requests over one HTTP/2 connection?",
+]
+
+
+async def run(args) -> dict:
+    import grpc
+
+    from bench import ensure_local_artifacts
+    from distributed_lms_raft_llm_tpu.engine import (
+        EngineConfig, PagedEngine, SamplingParams, TutoringEngine,
+    )
+    from distributed_lms_raft_llm_tpu.proto import lms_pb2, rpc
+    from distributed_lms_raft_llm_tpu.serving import tutoring_server
+    from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+    artifacts = ensure_local_artifacts()
+    config = EngineConfig(
+        model="gpt2",
+        checkpoint=artifacts["checkpoint"],
+        vocab_path=artifacts["vocab_path"],
+        merges_path=artifacts["merges_path"],
+        sampling=SamplingParams.reference_defaults(
+            max_new_tokens=args.max_new_tokens
+        ),
+        quant=args.quant,
+        kv_quant=args.kv_quant,
+    )
+    if args.paged:
+        engine = PagedEngine(config, slots=8)
+    else:
+        engine = TutoringEngine(config)
+    engine.warmup()
+
+    # Same queue + servicer stack serve_async wires, but bound to an
+    # ephemeral port the test can read back.
+    metrics = Metrics()
+    if args.paged:
+        from distributed_lms_raft_llm_tpu.engine import PagedQueue
+
+        queue = PagedQueue(engine, metrics=metrics)
+    else:
+        from distributed_lms_raft_llm_tpu.engine import BatchingQueue
+
+        queue = BatchingQueue(engine, max_batch=8, metrics=metrics)
+    await queue.start()
+    server = grpc.aio.server()
+    rpc.add_TutoringServicer_to_server(
+        tutoring_server.TutoringService(queue, metrics), server
+    )
+    port = server.add_insecure_port("127.0.0.1:0")
+    await server.start()
+
+    async def client(cid: int) -> list:
+        lat = []
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stub = rpc.TutoringStub(channel)
+            for q in range(args.queries):
+                question = QUESTIONS[(cid + q) % len(QUESTIONS)]
+                t0 = time.monotonic()
+                resp = await stub.GetLLMAnswer(
+                    lms_pb2.QueryRequest(token="t", query=question),
+                    timeout=120,
+                )
+                lat.append(time.monotonic() - t0)
+                assert resp.success, resp.response
+        return lat
+
+    t0 = time.monotonic()
+    per_client = await asyncio.gather(
+        *[client(i) for i in range(args.clients)]
+    )
+    wall = time.monotonic() - t0
+    await server.stop(None)
+    await queue.close()
+
+    snap = metrics.snapshot()
+    answer_lat = sorted(x for lats in per_client for x in lats)
+    n = len(answer_lat)
+    ttft = snap["latency"].get("ttft", {})
+    return {
+        "metric": "tutoring_server_ttft_p50_ms_under_concurrency",
+        "value": round(ttft.get("p50_s", 0.0) * 1000, 2),
+        "unit": "ms",
+        "clients": args.clients,
+        "queries_per_client": args.queries,
+        "engine": "paged" if args.paged else "batched",
+        "quant": args.quant or "bf16",
+        "kv_quant": args.kv_quant,
+        "ttft_p90_ms": round(ttft.get("p90_s", 0.0) * 1000, 2),
+        "ttft_count": ttft.get("count", 0),
+        "answer_p50_s": round(answer_lat[n // 2], 3),
+        "answer_p95_s": round(answer_lat[min(int(n * 0.95), n - 1)], 3),
+        "requests_per_s": round(n / wall, 2),
+        "wall_s": round(wall, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--queries", type=int, default=4)
+    ap.add_argument("--max-new-tokens", type=int, default=128)
+    ap.add_argument("--paged", action="store_true")
+    ap.add_argument("--quant", default=None, choices=["int8"])
+    ap.add_argument("--kv-quant", action="store_true")
+    args = ap.parse_args()
+    print(json.dumps(asyncio.run(run(args))))
+
+
+if __name__ == "__main__":
+    main()

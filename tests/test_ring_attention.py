@@ -1,0 +1,80 @@
+"""Ring attention (parallel/ring.py): exact parity with dense causal
+attention while the sequence is sharded over the sp mesh axis."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distributed_lms_raft_llm_tpu.models.common import attend
+from distributed_lms_raft_llm_tpu.parallel import make_mesh
+from distributed_lms_raft_llm_tpu.parallel.ring import ring_attention
+
+
+def _dense_causal(q, k, v):
+    t = q.shape[2]
+    pos = jnp.arange(t)
+    mask = (pos[None, :] <= pos[:, None])[None, None]
+    return attend(q, k, v, mask)
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense_causal(sp):
+    mesh = make_mesh({"sp": sp, "dp": -1})
+    rng = np.random.default_rng(0)
+    b, h, t, dh = 2, 4, 32, 16
+    q = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+    dense = _dense_causal(q, k, v)
+    with mesh:
+        ring = ring_attention(q, k, v, mesh,
+                              spec=P(None, None, "sp", None))
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(ring), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_composes_with_dp_and_tp():
+    """sp=2 x tp=2 x dp=2 on the 8-device mesh: batch, heads, and sequence
+    all sharded at once."""
+    mesh = make_mesh({"sp": 2, "tp": 2, "dp": -1})
+    assert mesh.shape["dp"] == 2
+    rng = np.random.default_rng(1)
+    b, h, t, dh = 4, 4, 16, 8
+    q = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+    dense = _dense_causal(q, k, v)
+    with mesh:
+        ring = ring_attention(q, k, v, mesh)
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(ring), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_under_jit_and_grad():
+    """Differentiable + jittable: the training path can use it."""
+    mesh = make_mesh({"sp": 4, "dp": -1})
+    rng = np.random.default_rng(2)
+    b, h, t, dh = 1, 2, 16, 8
+    q = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, h, t, dh)).astype(np.float32))
+
+    spec = P(None, None, "sp", None)
+
+    def ring_loss(q, k, v):
+        with mesh:
+            return jnp.sum(ring_attention(q, k, v, mesh, spec=spec) ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(_dense_causal(q, k, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(ring_loss))(q, k, v)
+    g_dense = jax.grad(dense_loss)(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(g_ring), np.asarray(g_dense), rtol=1e-4, atol=1e-4
+    )
