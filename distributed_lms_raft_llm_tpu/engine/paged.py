@@ -129,35 +129,51 @@ def _install_program(state: SlotState, slot, c1: KVCache, true_len, first,
 
 
 def _step_program(params, state: SlotState, rng, *, cfg, sampling,
-                  eos_id: int, pad_id: int, model) -> Tuple[SlotState, jax.Array]:
-    """One decode step for all S slots (per-row cache offsets)."""
+                  eos_id: int, pad_id: int, model,
+                  chunk: int = 1) -> Tuple[SlotState, jax.Array]:
+    """`chunk` decode steps for all S slots (per-row cache offsets).
+
+    Chunking exists because the paged loop is host-driven: every dispatch
+    costs a host->device->host round trip (~100 ms over the bench tunnel,
+    which at chunk=1 dominated answer latency ~300:1 over compute). One
+    program advancing `chunk` tokens amortizes that; the host reaps
+    finished slots at chunk granularity (a slot finishing mid-chunk decodes
+    pad tokens into its own — already dead — tail until the chunk ends).
+    Returns (state, tokens [chunk, S]).
+    """
     tmax = state.cache.k.shape[3]
-    # Inactive/full slots write into their current position; clamp to stay
-    # in bounds — the slot is dead or about to be evicted, the data ignored.
-    offs = jnp.minimum(state.cache.length, tmax - 1)
-    cache = state.cache._replace(length=offs)
-    kv_mask = jnp.arange(tmax)[None, :] <= offs[:, None]
-    logits, cache = model.forward(
-        params, cfg, state.tok[:, None], cache=cache, kv_mask=kv_mask
-    )
-    nxt = sample_step(rng, logits[:, 0], state.seen, sampling)
-    nxt = jnp.where(state.active, nxt, jnp.asarray(pad_id, jnp.int32))
-    still = state.active & (nxt != eos_id)
-    lengths = jnp.where(
-        state.active, jnp.minimum(state.cache.length + 1, tmax), state.cache.length
-    )
-    seen = jnp.where(
-        state.active[:, None], update_seen(state.seen, nxt), state.seen
-    )
-    return (
-        SlotState(
-            cache=cache._replace(length=lengths),
-            tok=nxt,
-            active=still,
-            seen=seen,
-        ),
-        nxt,
-    )
+
+    def one(s: SlotState, step_rng) -> Tuple[SlotState, jax.Array]:
+        # Inactive/full slots write into their current position; clamp to
+        # stay in bounds — the slot is dead or about to be evicted, the
+        # data ignored.
+        offs = jnp.minimum(s.cache.length, tmax - 1)
+        cache = s.cache._replace(length=offs)
+        kv_mask = jnp.arange(tmax)[None, :] <= offs[:, None]
+        logits, cache = model.forward(
+            params, cfg, s.tok[:, None], cache=cache, kv_mask=kv_mask
+        )
+        nxt = sample_step(step_rng, logits[:, 0], s.seen, sampling)
+        nxt = jnp.where(s.active, nxt, jnp.asarray(pad_id, jnp.int32))
+        still = s.active & (nxt != eos_id)
+        lengths = jnp.where(
+            s.active, jnp.minimum(s.cache.length + 1, tmax), s.cache.length
+        )
+        seen = jnp.where(
+            s.active[:, None], update_seen(s.seen, nxt), s.seen
+        )
+        return (
+            SlotState(
+                cache=cache._replace(length=lengths),
+                tok=nxt,
+                active=still,
+                seen=seen,
+            ),
+            nxt,
+        )
+
+    state, toks = jax.lax.scan(one, state, jax.random.split(rng, chunk))
+    return state, toks
 
 
 class PagedEngine:
@@ -171,9 +187,13 @@ class PagedEngine:
     """
 
     def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None,
-                 slots: Optional[int] = None):
+                 slots: Optional[int] = None, chunk: int = 8):
         enable_compilation_cache()
         self.config = config
+        # Tokens per dispatched step program — see _step_program. Mid-chunk
+        # admissions wait at most chunk device steps (ms-scale); host
+        # round-trips shrink by the same factor.
+        self.chunk = max(1, chunk)
         self.family, self.cfg = registry.resolve(
             config.model, config.dtype, config.param_dtype
         )
@@ -228,7 +248,8 @@ class PagedEngine:
         )
         self._step = jax.jit(
             partial(_step_program, eos_id=self.tokenizer.eos_id,
-                    pad_id=self.tokenizer.pad_id, **statics),
+                    pad_id=self.tokenizer.pad_id, chunk=self.chunk,
+                    **statics),
             donate_argnums=(1,),
         )
         self._rng = jax.random.key(config.seed)
@@ -333,7 +354,8 @@ class PagedEngine:
             self.last_ttft_s = ttft
 
     def step(self) -> List[Tuple[int, str]]:
-        """Admit pending requests, advance one decode step, reap finished."""
+        """Admit pending requests, advance one `chunk`-token step program,
+        reap finished slots."""
         self._admit()
         done: List[Tuple[int, str]] = []
         if not any(r is not None for r in self._slot_req):
@@ -341,26 +363,36 @@ class PagedEngine:
         self._rng, rng = jax.random.split(self._rng)
         with self.mesh:
             self.state, toks = self._step(self.params, self.state, rng)
-            toks = np.asarray(toks)
-            active = np.asarray(self.state.active)
+            toks = np.asarray(toks)  # [chunk, S]; the ONE sync per chunk
+        eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
-            tok = int(toks[slot])
-            emitted_eos = not bool(active[slot])
-            if not emitted_eos or tok != self.tokenizer.pad_id:
+            finished = False
+            for t in toks[:, slot]:
+                tok = int(t)
+                if tok == eos:
+                    # eos lands in the transcript when it's a distinct
+                    # token (decode() filters it); GPT-2's pad==eos stays
+                    # out, matching the reference's decoded text.
+                    if tok != pad:
+                        req.tokens.append(tok)
+                    finished = True
+                    break
                 req.tokens.append(tok)
-            # Third clause: force-finish a slot whose cache hit tmax (only
-            # reachable if a caller bypasses the __init__ length check) —
-            # past tmax the clamped scatter would corrupt its newest KV slot.
-            finished = (
-                emitted_eos
-                or len(req.tokens) >= req.max_new
-                or req.prompt_len + len(req.tokens) >= self.tmax
-            )
+                # Final clause: force-finish a slot whose cache hit tmax
+                # (only reachable if a caller bypasses the __init__ length
+                # check) — past tmax the clamped scatter would corrupt its
+                # newest KV slot.
+                if (
+                    len(req.tokens) >= req.max_new
+                    or req.prompt_len + len(req.tokens) >= self.tmax
+                ):
+                    finished = True
+                    break
             if finished:
                 text = self.tokenizer.decode(
-                    [t for t in req.tokens if t != self.tokenizer.eos_id]
+                    [t for t in req.tokens if t != eos]
                 )
                 done.append((req.rid, text))
                 self._slot_req[slot] = None
