@@ -30,7 +30,13 @@ def enable_compilation_cache(path: Optional[str] = None) -> str:
     path = (
         path
         or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        or os.path.expanduser("~/.cache/dlrl_tpu/xla_cache")
+        # Keyed by backend platform: CPU and TPU processes sharing one dir
+        # poisons CPU starts with AOT entries compiled for other targets /
+        # other machines' vector features (observed: minutes of
+        # cpu_aot_loader feature-mismatch churn before the server came up).
+        or os.path.expanduser(
+            f"~/.cache/dlrl_tpu/xla_cache_{jax.default_backend()}"
+        )
     )
     if _enabled:
         return path
