@@ -507,7 +507,8 @@ def main(argv=None) -> None:
                              "the server list")
     args = parser.parse_args(argv)
     servers = args.servers.split(",")
-    if args.config:
+    # Explicit --servers beats the file (same precedence as the servers).
+    if args.config and args.servers == parser.get_default("servers"):
         from ..config import load_config
 
         servers = load_config(args.config).client_servers

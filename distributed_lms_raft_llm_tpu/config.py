@@ -138,6 +138,15 @@ def load_config(path: str) -> AppConfig:
 # --------------------------------------------------- entrypoint adapters
 
 
+def apply_file_defaults(args, parser, overrides: Dict[str, Any]) -> None:
+    """Two-phase CLI/TOML merge, shared by every entrypoint: the file fills
+    each value the command line left at its parser default; explicitly
+    passed flags win (detected by comparing against `parser.get_default`)."""
+    for name, value in overrides.items():
+        if getattr(args, name) == parser.get_default(name):
+            setattr(args, name, value)
+
+
 def sampling_params(cfg: AppConfig):
     from .engine import SamplingParams
 

@@ -15,6 +15,7 @@ torch Linear ([out, in] — transposed here).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Mapping
 
 import numpy as np
@@ -286,11 +287,16 @@ def save_safetensors(path: str, tensors: Mapping[str, np.ndarray]) -> None:
         blobs.append(raw)
         offset += len(raw)
     head = json.dumps(header).encode()
-    with open(path, "wb") as f:
+    # Atomic: a crash mid-write must not destroy the previous checkpoint —
+    # train.fit() overwrites the SAME path every cadence, and resume depends
+    # on it being loadable.
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(struct.pack("<Q", len(head)))
         f.write(head)
         for raw in blobs:
             f.write(raw)
+    os.replace(tmp, path)
 
 
 def load_safetensors(path: str) -> Dict[str, np.ndarray]:

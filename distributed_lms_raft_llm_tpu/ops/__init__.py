@@ -8,4 +8,4 @@ live here only where a hand schedule measurably beats it. Current contents:
   pass per layer).
 """
 
-from .attention import decode_attention, use_fused_decode_attention  # noqa: F401
+from .attention import decode_attention  # noqa: F401

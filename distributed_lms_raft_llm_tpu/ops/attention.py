@@ -26,7 +26,6 @@ to buy TPU headroom.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -77,7 +76,8 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     bias     [B, 1, S] f32 — additive mask (0 = attend, NEG_INF = not)
     returns  [B, H, 1, Dh] in q's dtype.
 
-    Call sites gate on platform (`use_fused_decode_attention`); this
+    Gating lives in the engine (`EngineConfig.fused_attention` sets the
+    model config's `fused_decode_attention`, unsharded-mesh only); this
     function assumes a TPU backend.
     """
     b, h, t, dh = q.shape
@@ -115,11 +115,3 @@ def mask_to_bias(mask: jax.Array) -> jax.Array:
     return jnp.where(mask[:, 0, 0, :], 0.0, NEG_INF).astype(jnp.float32)[
         :, None, :
     ]
-
-
-def use_fused_decode_attention(q: jax.Array) -> bool:
-    """True when the pallas decode kernel applies: single query token and a
-    TPU backend (CPU tests and golden runs keep the reference einsum path)."""
-    if os.environ.get("DLRL_NO_PALLAS_ATTN"):
-        return False
-    return q.shape[2] == 1 and jax.default_backend() == "tpu"

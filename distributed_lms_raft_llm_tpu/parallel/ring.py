@@ -64,9 +64,8 @@ def _ring_block(q, k, v, q_offset, kv_offset, scale, m, l, o):
     return m_new, l_new, o_new
 
 
-def _ring_attention_shard(q, k, v, *, axis_name: str, scale: float):
+def _ring_attention_shard(q, k, v, *, n: int, axis_name: str, scale: float):
     """Per-device body under shard_map: rotate K/V around the ring."""
-    n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     tq = q.shape[2]
     q_offset = idx * tq
@@ -92,7 +91,13 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, scale: float):
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m, l, o
 
-    _, _, _, l, o = jax.lax.fori_loop(0, n, body, (k, v, m, l, o))
+    # n-1 rotate-and-accumulate steps, then the final block with no
+    # trailing ppermute — the last rotation's K+V shard transfer would be
+    # pure discarded ICI traffic.
+    k, v, m, l, o = jax.lax.fori_loop(0, n - 1, body, (k, v, m, l, o))
+    m, l, o = _ring_block(
+        q, k, v, q_offset, ((idx - (n - 1)) % n) * tq, scale, m, l, o
+    )
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
@@ -116,7 +121,8 @@ def ring_attention(
     spec = spec or P("dp", "tp", axis_name, None)
     fn = shard_map(
         functools.partial(
-            _ring_attention_shard, axis_name=axis_name, scale=scale
+            _ring_attention_shard, n=mesh.shape[axis_name],
+            axis_name=axis_name, scale=scale,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -189,7 +189,7 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     args.linearizable_reads = not args.no_linearizable_reads
     if args.config:
-        from ..config import load_config
+        from ..config import apply_file_defaults, load_config
 
         cfg = load_config(args.config)
         args.id = args.id_flag if args.id_flag is not None else args.id
@@ -197,34 +197,23 @@ def main(argv=None) -> None:
             parser.error("--config requires --id <node id>")
         if args.id not in cfg.cluster.nodes:
             parser.error(f"node id {args.id} not in [cluster.nodes]")
-        # File fills everything the CLI left at its default; explicit
-        # flags (compared against parser defaults) win.
-        d = parser.get_default
+        # Topology always comes from the file; everything else merges with
+        # explicit-flags-win precedence.
         args.peers = [cfg.cluster.nodes[k] for k in sorted(cfg.cluster.nodes)]
         args.port = int(cfg.cluster.nodes[args.id].rsplit(":", 1)[1])
-        if args.data_dir == d("data_dir"):
-            args.data_dir = os.path.join(cfg.cluster.data_dir,
-                                         f"node{args.id}")
-        if args.tutoring == d("tutoring"):
-            args.tutoring = cfg.tutoring.address
-        if args.tutoring_auth_key_file == d("tutoring_auth_key_file"):
-            args.tutoring_auth_key_file = cfg.tutoring.auth_key_file
-        if args.gate_model == d("gate_model"):
-            args.gate_model = cfg.gate.model
-        if args.gate_checkpoint == d("gate_checkpoint"):
-            args.gate_checkpoint = cfg.gate.checkpoint
-        if args.gate_vocab == d("gate_vocab"):
-            args.gate_vocab = cfg.gate.vocab
-        if args.gate_threshold == d("gate_threshold"):
-            args.gate_threshold = cfg.gate.threshold
-        if args.election_timeout == d("election_timeout"):
-            args.election_timeout = cfg.cluster.election_timeout
-        if args.heartbeat_interval == d("heartbeat_interval"):
-            args.heartbeat_interval = cfg.cluster.heartbeat_interval
-        if args.metrics_period == d("metrics_period"):
-            args.metrics_period = cfg.cluster.metrics_period
-        if args.snapshot_every == d("snapshot_every"):
-            args.snapshot_every = cfg.cluster.snapshot_every
+        apply_file_defaults(args, parser, {
+            "data_dir": os.path.join(cfg.cluster.data_dir, f"node{args.id}"),
+            "tutoring": cfg.tutoring.address,
+            "tutoring_auth_key_file": cfg.tutoring.auth_key_file,
+            "gate_model": cfg.gate.model,
+            "gate_checkpoint": cfg.gate.checkpoint,
+            "gate_vocab": cfg.gate.vocab,
+            "gate_threshold": cfg.gate.threshold,
+            "election_timeout": cfg.cluster.election_timeout,
+            "heartbeat_interval": cfg.cluster.heartbeat_interval,
+            "metrics_period": cfg.cluster.metrics_period,
+            "snapshot_every": cfg.cluster.snapshot_every,
+        })
         if not args.no_linearizable_reads:
             args.linearizable_reads = cfg.cluster.linearizable_reads
     elif args.id is None or args.port is None or not args.peers:

@@ -195,21 +195,17 @@ def main(argv=None) -> None:
     )
     args = parser.parse_args(argv)
     if args.config:
-        from ..config import load_config
+        from ..config import apply_file_defaults, load_config
 
         cfg = load_config(args.config)
         t, s = cfg.tutoring, cfg.sampling
-        d = parser.get_default
-        overrides = {
+        apply_file_defaults(args, parser, {
             "port": t.port, "model": t.model, "checkpoint": t.checkpoint,
             "vocab": t.vocab, "merges": t.merges, "tp": t.tp,
             "quant": t.quant, "max_new_tokens": s.max_new_tokens,
             "max_batch": t.max_batch, "max_wait_ms": t.max_wait_ms,
             "slots": t.slots, "auth_key_file": t.auth_key_file,
-        }
-        for name, value in overrides.items():
-            if getattr(args, name) == d(name):
-                setattr(args, name, value)
+        })
         if not args.kv_quant:
             args.kv_quant = t.kv_quant
         if not args.paged:
