@@ -5,7 +5,6 @@ from .pipeline import pipeline_trunk  # noqa: F401
 from .ring import ring_attention  # noqa: F401
 from .partition import (  # noqa: F401
     BERT_RULES,
-    CACHE_SPEC,
     GPT2_RULES,
     match_partition_rules,
     shard_tree,

@@ -65,10 +65,10 @@ BERT_RULES: List[Tuple[str, PartitionSpec]] = [
     (r".*", P()),
 ]
 
-# KV cache [L, B, Hkv, T, Dh]: batch over dp, heads over tp.
-CACHE_SPEC = P(None, "dp", "tp", None, None)
-
 # Rule set per model-family name (models/registry.py ModelFamily.name).
+# (KV-cache sharding — [L, B, Hkv, T, Dh]: batch over dp, heads over tp —
+# is derived by jit's sharding propagation from the param/batch specs; no
+# hand-placed constant needed.)
 RULES_FOR = {
     "gpt2": GPT2_RULES,
     "llama": LLAMA_RULES,
