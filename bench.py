@@ -52,7 +52,8 @@ def ensure_local_artifacts() -> dict:
 TORCH_CPU_FALLBACK_TPS = 15.0
 
 
-def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False) -> dict:
+def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False,
+              batch: int = BATCH) -> dict:
     import jax
 
     from distributed_lms_raft_llm_tpu.engine import (
@@ -71,7 +72,7 @@ def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False) -> dict:
             model=model,
             sampling=SamplingParams.reference_defaults(max_new_tokens=MAX_NEW),
             length_buckets=(PROMPT_LEN, 64, 128),
-            batch_buckets=(1, 2, 4, 8),
+            batch_buckets=tuple(sorted({1, 2, 4, 8, batch})),
             tp=tp,
             # The production serving config (tutoring_server --quant int8
             # --kv-quant): weight-only int8 + int8 KV cache, near-lossless
@@ -84,8 +85,8 @@ def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False) -> dict:
     )
     rng = np.random.default_rng(0)
     ids = rng.integers(0, engine.tokenizer.vocab_size,
-                       (BATCH, PROMPT_LEN)).astype(np.int32)
-    mask = np.ones((BATCH, PROMPT_LEN), bool)
+                       (batch, PROMPT_LEN)).astype(np.int32)
+    mask = np.ones((batch, PROMPT_LEN), bool)
 
     compile_t0 = time.monotonic()
     engine.generate_ids(ids, mask)  # compile + warm
@@ -120,7 +121,7 @@ def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False) -> dict:
         "tokens_per_sec_per_chip": tps / n_chips,
         "ttft_p50_ms": ttft_ms,
         "compile_s": compile_s,
-        "batch": BATCH,
+        "batch": batch,
         "platform": jax.devices()[0].platform,
     }
 
@@ -171,6 +172,8 @@ def main() -> None:
                     help="BASELINE config to bench (default: the headline)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel ways (config 4: gpt2-large tp)")
+    ap.add_argument("--batch", type=int, default=BATCH,
+                    help="device batch (BASELINE config is 8)")
     ap.add_argument("--config", default=None,
                     help="TOML deployment file; [tutoring] model/tp apply")
     args = ap.parse_args()
@@ -183,8 +186,9 @@ def main() -> None:
             args.model = t.model
         if args.tp == 1:
             args.tp = t.tp
-    quant = bench_tpu(args.model, args.tp, quant=True) if args.tp == 1 else None
-    tpu = bench_tpu(args.model, args.tp)
+    quant = (bench_tpu(args.model, args.tp, quant=True, batch=args.batch)
+             if args.tp == 1 else None)
+    tpu = bench_tpu(args.model, args.tp, batch=args.batch)
     baseline_tps = bench_torch_baseline(args.model)
     name = {"gpt2": "gpt2_small"}.get(args.model, args.model.replace("-", "_"))
     if args.tp > 1:

@@ -10,16 +10,21 @@ the frozen `lms.proto` gRPC contract.
 Subpackages
 -----------
 - ``proto``    — frozen wire contract, generated messages, RPC glue
-- ``models``   — functional JAX models (GPT-2, BERT, Llama) as param pytrees
-- ``ops``      — Pallas TPU kernels and sampling ops
-- ``parallel`` — mesh construction, partition rules, ring attention, collectives
-- ``engine``   — inference runtime: KV cache, prefill/decode, batching, gate
-- ``train``    — sharded training step (loss, optimizer, TrainState)
-- ``raft``     — sans-IO Raft core + storage + gRPC/in-memory transports
+- ``models``   — functional JAX models (GPT-2, BERT, Llama) as param
+  pytrees, HF conversion, weight-only int8 + int8-KV quantization
+- ``ops``      — Pallas TPU kernels (fused decode attention)
+- ``parallel`` — mesh, partition rules, ring attention (sp), pipeline (pp)
+- ``engine``   — inference runtime: KV cache, prefill/decode, group batching
+  and continuous batching (``paged``), sampling, relevance gate
+- ``train``    — sharded fine-tuning: data pipeline, train step,
+  checkpoint/resume, HF export
+- ``raft``     — sans-IO Raft core + durable WAL + compaction/InstallSnapshot
+  + linearizable read barrier + gRPC/in-memory transports
 - ``lms``      — LMS state machine, appliers, persistence, file replication
 - ``serving``  — server entrypoints (lms_server, tutoring_server)
-- ``client``   — leader-discovering client library + CLI
-- ``utils``    — config, logging, metrics, tokenizer
+- ``client``   — leader-discovering client library + terminal client + GUI
+- ``utils``    — tokenizers, PDF text, metrics, health endpoint, auth
+- ``config``   — one declarative TOML for the whole deployment
 """
 
 __version__ = "0.1.0"
