@@ -169,6 +169,11 @@ def main(argv=None) -> None:
         "--kv-quant", action="store_true",
         help="int8 KV cache with per-slot scales",
     )
+    parser.add_argument(
+        "--approx-topk", action="store_true",
+        help="approximate top-k sampling (~0.95 recall, +12%% decode "
+        "throughput); default is bit-exact HF semantics",
+    )
     parser.add_argument("--max-new-tokens", type=int, default=128)
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--max-wait-ms", type=float, default=10.0)
@@ -210,6 +215,8 @@ def main(argv=None) -> None:
             args.kv_quant = t.kv_quant
         if not args.paged:
             args.paged = t.paged
+        if not args.approx_topk:
+            args.approx_topk = s.approx_top_k
         args.sampling_overrides = dict(
             temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
             repetition_penalty=s.repetition_penalty,
@@ -226,7 +233,8 @@ def main(argv=None) -> None:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     sampling = SamplingParams.reference_defaults(
-        max_new_tokens=args.max_new_tokens, **args.sampling_overrides
+        max_new_tokens=args.max_new_tokens, approx_top_k=args.approx_topk,
+        **args.sampling_overrides,
     )
     config = EngineConfig(
         model=args.model,

@@ -75,3 +75,28 @@ def test_seen_mask_roundtrip():
     np.testing.assert_array_equal(np.asarray(mask), expect)
     mask2 = sampling.update_seen(mask, jnp.asarray([7, 0]))
     assert bool(mask2[0, 7]) and bool(mask2[1, 0])
+
+
+def test_approx_top_k_samples_from_plausible_set():
+    """approx_top_k=True (serving opt-in, ~0.95 recall) still samples only
+    high-logit tokens; exact parity is not promised, membership near the
+    top is."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_lms_raft_llm_tpu.engine.sampling import (
+        SamplingParams, sample_step,
+    )
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 5000)).astype(np.float32))
+    seen = jnp.zeros((4, 5000), bool)
+    params = SamplingParams(approx_top_k=True, max_new_tokens=4)
+    toks = sample_step(jax.random.key(0), logits, seen, params)
+    # Every sample lands within the exact top-2k (k=50 with generous slack
+    # for the approximate bins).
+    _, exact_idx = jax.lax.top_k(logits, 100)
+    for row in range(4):
+        assert int(toks[row]) in np.asarray(exact_idx[row]), row
