@@ -75,10 +75,6 @@ class EngineConfig:
     param_dtype: Any = jnp.bfloat16
     seed: int = 0
 
-    @staticmethod
-    def model_config(name: str, dtype, param_dtype=None):
-        return registry.resolve(name, dtype, param_dtype)[1]
-
 
 class TutoringEngine:
     def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None):

@@ -363,12 +363,16 @@ class PagedEngine:
         self._rng, rng = jax.random.split(self._rng)
         with self.mesh:
             self.state, toks = self._step(self.params, self.state, rng)
-            toks = np.asarray(toks)  # [chunk, S]; the ONE sync per chunk
+            # One sync per chunk; active rides along so slot death is read
+            # from the program, not inferred from token values.
+            toks = np.asarray(toks)  # [chunk, S]
+            active = np.asarray(self.state.active)
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
             finished = False
+            dead = not bool(active[slot])
             for t in toks[:, slot]:
                 tok = int(t)
                 if tok == eos:
@@ -377,6 +381,14 @@ class PagedEngine:
                     # out, matching the reference's decoded text.
                     if tok != pad:
                         req.tokens.append(tok)
+                    finished = True
+                    break
+                if dead and tok == pad:
+                    # Inactive-slot filler (the slot died at admission or
+                    # in an earlier chunk, before any eos could appear in
+                    # THIS chunk) — not content. Matters when pad != eos:
+                    # without the device flag these pads would be appended
+                    # as answer tokens.
                     finished = True
                     break
                 req.tokens.append(tok)
@@ -390,6 +402,8 @@ class PagedEngine:
                 ):
                     finished = True
                     break
+            if dead:
+                finished = True
             if finished:
                 text = self.tokenizer.decode(
                     [t for t in req.tokens if t != eos]

@@ -296,6 +296,8 @@ def save_safetensors(path: str, tensors: Mapping[str, np.ndarray]) -> None:
         f.write(head)
         for raw in blobs:
             f.write(raw)
+        f.flush()
+        os.fsync(f.fileno())  # durable before the rename, not just ordered
     os.replace(tmp, path)
 
 

@@ -112,6 +112,12 @@ def pipeline_trunk(
     b = x.shape[0]
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if layers % n_stages:
+        raise ValueError(
+            f"{layers} stacked layers not divisible by the {axis_name} "
+            f"axis size {n_stages}"
+        )
     param_spec = param_spec or P(axis_name)
     xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
 

@@ -54,6 +54,8 @@ def save_train_state(path: str, state: Any) -> None:
     tmp = path + ".json.tmp"
     with open(tmp, "w") as fh:
         json.dump(meta, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
     os.replace(tmp, path + ".json")
 
 

@@ -152,3 +152,31 @@ def test_paged_queue_recovers_after_step_failure():
         return answer
 
     assert isinstance(asyncio.run(run()), str)
+
+
+def test_dead_slot_pad_filler_not_appended_when_pad_differs_from_eos():
+    """Regression (review): with a tokenizer where pad != eos, a slot that
+    is inactive from admission (first sampled token is eos) must return an
+    empty answer — chunk pad filler is not content."""
+    import numpy as np
+
+    from distributed_lms_raft_llm_tpu.engine.paged import PagedEngine
+
+    paged = PagedEngine(make_config(), slots=2)
+    # Force pad != eos and make admission sample eos immediately by
+    # stubbing the prefill program's sampled first token.
+    paged.tokenizer.pad_id = 0
+    assert paged.tokenizer.eos_id != 0
+    real_prefill = paged._prefill
+
+    def eos_first(params, ids, true_len, rng):
+        cache, _first, seen = real_prefill(params, ids, true_len, rng)
+        import jax.numpy as jnp
+
+        return cache, jnp.asarray(paged.tokenizer.eos_id, jnp.int32), seen
+
+    paged._prefill = eos_first
+    rid = paged.submit("anything at all")
+    out = paged.drain()
+    # The request finished with no pad-filler tokens decoded as content.
+    assert out[rid] == paged.tokenizer.decode([])
