@@ -67,3 +67,19 @@ def test_gpt2_medium_topology_tp4_dp2_decode():
     result = _sharded_generate(cfg, tp=4, batch=2, bucket=16, max_new=4)
     assert result.tokens.shape == (2, 4)
     assert (result.tokens < cfg.vocab_size).all()
+
+
+def test_tp_sharded_decode_with_int8_kv_cache():
+    """kv_quant under tensor parallelism: the int8 cache planes and their
+    [L, B, H, S] scale planes must ride jit's sharding propagation next to
+    the tp-sharded head axis without repartition errors."""
+    cfg = dataclasses.replace(
+        gpt2.GPT2Config(dtype=jnp.float32, param_dtype=jnp.float32),
+        hidden_size=64, num_layers=4, num_heads=8,
+        vocab_size=512, max_position_embeddings=64,
+        quant_kv=True,
+    )
+    result = _sharded_generate(cfg, tp=4, batch=2, bucket=16, max_new=4)
+    assert result.tokens.shape == (2, 4)
+    assert (result.tokens < cfg.vocab_size).all()
+    assert np.isfinite(result.lengths).all()
