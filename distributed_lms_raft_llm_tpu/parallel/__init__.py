@@ -1,6 +1,12 @@
 """SPMD parallelism: mesh construction, partition rules, ring attention."""
 
-from .mesh import make_mesh, named_sharding, single_device_mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    initialize_multihost,
+    make_hybrid_mesh,
+    make_mesh,
+    named_sharding,
+    single_device_mesh,
+)
 from .pipeline import pipeline_trunk  # noqa: F401
 from .ring import ring_attention  # noqa: F401
 from .partition import (  # noqa: F401

@@ -62,6 +62,77 @@ def make_mesh(
     return Mesh(mesh_devices, axis_order)
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join a multi-host JAX cluster; no-op for single-process runs.
+
+    Multi-host is the scale-out story the reference reaches with one gRPC
+    process per machine (SURVEY.md §2.2 — no collective backend at all):
+    here each host runs one process, `jax.distributed.initialize` wires the
+    cross-host runtime, and `jax.devices()` becomes the GLOBAL device set so
+    the same `make_mesh`/`make_hybrid_mesh` + NamedSharding code drives
+    1 chip or a pod slice. Arguments fall back to JAX's standard environment
+    (JAX_COORDINATOR_ADDRESS / ..NUM_PROCESSES / ..PROCESS_ID, or the TPU
+    metadata on Cloud TPU VMs). Returns True if distributed mode was
+    initialized.
+    """
+    import os
+
+    configured = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if not configured and (num_processes in (None, 1)):
+        return False  # single-process: local devices only
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def make_hybrid_mesh(
+    ici_axis_sizes: dict,
+    dcn_axis_sizes: Optional[dict] = None,
+    *,
+    axis_order: Tuple[str, ...] = ("dp", "pp", "sp", "tp"),
+) -> Mesh:
+    """DCN × ICI hybrid mesh for multi-host topologies.
+
+    `dcn_axis_sizes` are the axes that SPAN HOSTS (usually just dp: the
+    gradient all-reduce and request batch tolerate DCN latency), and
+    `ici_axis_sizes` the within-host axes (tp/sp/pp want ICI bandwidth).
+    Device order comes from `mesh_utils.create_hybrid_device_mesh`, which
+    keeps each host's chips contiguous on the ICI axes. With a single
+    process (all dcn sizes 1) this degrades to `make_mesh` semantics, so
+    the code path is exercised by the CPU test mesh too.
+    """
+    from jax.experimental import mesh_utils
+
+    dcn_axis_sizes = dict(dcn_axis_sizes or {})
+    unknown = [
+        a for a in (*ici_axis_sizes, *dcn_axis_sizes) if a not in axis_order
+    ]
+    if unknown:
+        raise ValueError(f"unknown mesh axes {unknown}; expected {axis_order}")
+    ici = [ici_axis_sizes.get(a, 1) for a in axis_order]
+    dcn = [dcn_axis_sizes.get(a, 1) for a in axis_order]
+    if math.prod(dcn) == 1:
+        # Single-granule: identical to a flat local mesh.
+        sizes = {
+            a: ici_axis_sizes.get(a, 1) * dcn_axis_sizes.get(a, 1)
+            for a in axis_order
+        }
+        return make_mesh(sizes, axis_order=axis_order)
+    devices = mesh_utils.create_hybrid_device_mesh(
+        ici, dcn, devices=jax.devices()
+    )
+    return Mesh(devices, axis_order)
+
+
 def single_device_mesh() -> Mesh:
     """Trivial mesh (1 chip) — lets the same pjit code path serve everywhere."""
     return make_mesh({})

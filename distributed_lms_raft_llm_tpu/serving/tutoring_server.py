@@ -230,6 +230,14 @@ def main(argv=None) -> None:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # Multi-host: joins the JAX cluster when JAX_COORDINATOR_ADDRESS (or
+    # Cloud TPU metadata) is present, making jax.devices() global so the
+    # tp/dp mesh spans hosts; no-op for the common single-host run.
+    from ..parallel.mesh import initialize_multihost
+
+    if initialize_multihost():
+        log.info("joined multi-host JAX cluster")
+
     sampling = SamplingParams.reference_defaults(
         max_new_tokens=args.max_new_tokens, approx_top_k=args.approx_topk,
         **args.sampling_overrides,
