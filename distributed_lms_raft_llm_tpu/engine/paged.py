@@ -11,16 +11,19 @@ joins the running batch at the next step instead of queueing behind it.
 Layout differences from the bucketed path (both by design):
 - prompts are RIGHT-padded into their slot (slot position 0 = first prompt
   token) so per-slot raggedness is just a length integer;
-- decode is a host-driven loop over a jitted single-step program (admission
-  needs host control between steps), not a device-side while_loop. The step
-  is still one fused device program: forward + sampling for all S slots.
+- decode is a host-driven loop over a jitted CHUNKED step program
+  (admission needs host control between dispatches), not a device-side
+  while_loop. Each dispatch advances `chunk` tokens for all S slots with
+  one readback — see `_step_program` for why chunking is load-bearing on
+  high-dispatch-latency links.
 
-Two jitted programs, compiled once each:
+Three jitted programs, compiled once each:
 - `_prefill`: one prompt through the model into a fresh single-slot cache,
-  first token sampled; a splice program installs it into the live state at
-  the target slot.
+  first token sampled;
+- `_install`: splices a prefilled slot into the live donated state;
 - `_step`: [S,1] last-tokens forward with per-row cache offsets (the
-  models' ragged-slot scatter path), fused sampling, lengths/active update.
+  models' ragged-slot scatter path), fused sampling, lengths/active
+  update, scanned over `chunk` tokens.
 
 The reference has no analogue (HF `generate`, one request at a time —
 reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
