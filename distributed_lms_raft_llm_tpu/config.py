@@ -87,6 +87,7 @@ class GateConfig:
     checkpoint: Optional[str] = None
     vocab: Optional[str] = None
     threshold: float = 0.6       # reference: lms_server.py:1267
+    quant: Optional[str] = None  # weight-only int8 for the gate encoder
 
 
 @dataclasses.dataclass

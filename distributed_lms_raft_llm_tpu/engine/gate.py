@@ -35,6 +35,9 @@ class GateConfig:
     threshold: float = 0.6            # reference lms_server.py:1267
     length_buckets: Tuple[int, ...] = (64, 128, 256, 512)
     tp: int = 1
+    # Weight-only int8 (models/quant.py) — same near-lossless recipe as the
+    # tutoring engine; cosine similarity is scale-tolerant by construction.
+    quant: Optional[str] = None
     dtype: Any = jnp.bfloat16
     seed: int = 1
 
@@ -57,6 +60,14 @@ class RelevanceGate:
         else:
             log.warning("no BERT checkpoint configured — random init")
             params = bert.init_params(jax.random.key(config.seed), self.cfg)
+        if config.quant:
+            if config.quant != "int8":
+                raise ValueError(f"unsupported quant mode {config.quant!r}")
+            if config.tp != 1:
+                raise ValueError("quant='int8' requires tp=1")
+            from ..models import quant as quant_lib
+
+            params = quant_lib.quantize_params(params, "bert")
         self.params = partition.shard_tree(params, self.mesh, partition.BERT_RULES)
         self._embed = jax.jit(partial(bert.embed, cfg=self.cfg))
 

@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import quant
 from .common import attend, dense, layer_norm, merge_heads, split_heads
 
 Params = Dict[str, Any]
@@ -113,7 +114,7 @@ def forward(
 
     emb = params["embeddings"]
     x = (
-        emb["word"][input_ids]
+        quant.embed_lookup(emb["word"], input_ids)
         + emb["position"][jnp.arange(t)][None, :, :]
         + emb["token_type"][token_type_ids]
     )

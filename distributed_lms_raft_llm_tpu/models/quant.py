@@ -52,6 +52,13 @@ _QUANT_LEAVES = {
         ("blocks", "mlp", "wu"),
         ("blocks", "mlp", "wd"),
     },
+    "bert": {
+        ("embeddings", "word"),
+        ("blocks", "attn", "wqkv"),
+        ("blocks", "attn", "wo"),
+        ("blocks", "mlp", "wi"),
+        ("blocks", "mlp", "wo"),
+    },
 }
 
 
@@ -84,7 +91,7 @@ def is_quantized(w: Any) -> bool:
 def quantize_params(params: Params, family: str) -> Params:
     """Quantize the configured leaves of a model family's param tree."""
     leaves = _QUANT_LEAVES[family]
-    emb_leaves = {("wte",), ("embed",), ("lm_head",)}
+    emb_leaves = {("wte",), ("embed",), ("lm_head",), ("embeddings", "word")}
 
     def walk(tree, path=()):
         if not isinstance(tree, dict):

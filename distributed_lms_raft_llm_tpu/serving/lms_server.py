@@ -69,7 +69,8 @@ async def serve_async(args) -> None:
         gate = RelevanceGate(
             GateConfig(model=args.gate_model, checkpoint=args.gate_checkpoint,
                        vocab_path=args.gate_vocab,
-                       threshold=args.gate_threshold)
+                       threshold=args.gate_threshold,
+                       quant=args.gate_quant)
         )
         gate.warmup()
 
@@ -170,6 +171,8 @@ def main(argv=None) -> None:
     parser.add_argument("--gate-checkpoint", default=None)
     parser.add_argument("--gate-vocab", default=None)
     parser.add_argument("--gate-threshold", type=float, default=0.6)
+    parser.add_argument("--gate-quant", default=None, choices=["int8"],
+                        help="weight-only int8 for the BERT gate")
     parser.add_argument("--election-timeout", type=float, default=0.5)
     parser.add_argument("--heartbeat-interval", type=float, default=0.1)
     parser.add_argument("--metrics-period", type=float, default=60.0)
@@ -209,6 +212,7 @@ def main(argv=None) -> None:
             "gate_checkpoint": cfg.gate.checkpoint,
             "gate_vocab": cfg.gate.vocab,
             "gate_threshold": cfg.gate.threshold,
+            "gate_quant": cfg.gate.quant,
             "election_timeout": cfg.cluster.election_timeout,
             "heartbeat_interval": cfg.cluster.heartbeat_interval,
             "metrics_period": cfg.cluster.metrics_period,

@@ -165,3 +165,24 @@ def test_engine_quant_requires_tp1():
         TutoringEngine(
             EngineConfig(model="tiny", quant="int8", tp=2, sampling=sampling)
         )
+
+
+def test_bert_gate_quantized_similarity_close():
+    """int8 BERT gate: cosine similarities track full precision closely
+    (the gate decision is a 0.6 threshold on cosine — scale-tolerant)."""
+    from distributed_lms_raft_llm_tpu.engine.gate import (
+        GateConfig, RelevanceGate,
+    )
+
+    full = RelevanceGate(GateConfig(model="tiny", dtype=jnp.float32))
+    q = RelevanceGate(
+        GateConfig(model="tiny", dtype=jnp.float32, quant="int8")
+    )
+    pairs = [
+        ("how does raft elect a leader", "raft consensus and elections"),
+        ("what is a matrix", "cooking with garlic butter"),
+    ]
+    for a, b in pairs:
+        _, sim_full = full.check(a, b)
+        _, sim_q = q.check(a, b)
+        assert abs(float(sim_full) - float(sim_q)) < 0.05, (a, b)
