@@ -140,12 +140,42 @@ def load_config(path: str) -> AppConfig:
 # --------------------------------------------------- entrypoint adapters
 
 
-def apply_file_defaults(args, parser, overrides: Dict[str, Any]) -> None:
+_UNSET = object()
+
+
+def apply_file_defaults(
+    args, parser, overrides: Dict[str, Any], *,
+    argv: Optional[List[str]],
+) -> None:
     """Two-phase CLI/TOML merge, shared by every entrypoint: the file fills
-    each value the command line left at its parser default; explicitly
-    passed flags win (detected by comparing against `parser.get_default`)."""
+    each value the command line left unset; explicitly passed flags win.
+
+    Explicitness is detected by re-parsing `argv` (the exact list the
+    caller parsed; None = sys.argv, keyword-required so callers can't
+    forget to thread it) onto a namespace whose dests are pre-seeded with
+    a sentinel: argparse only assigns defaults to attributes the namespace
+    lacks, so a dest still holding the sentinel afterwards was never given
+    on the command line. (Comparing values against `parser.get_default` —
+    the previous scheme — misreads an explicit flag that happens to equal
+    its parser default, e.g. `--gate-threshold 0.6` would lose to a TOML
+    value of 0.7.) Caveat: absent optional POSITIONALS are still assigned
+    their defaults by argparse (overwriting the sentinel), so positional
+    dests must be merged by hand, never via `overrides` — both that and
+    typo'd keys are rejected below.
+    """
+    import argparse as _argparse
+
+    flag_dests = {a.dest for a in parser._actions if a.option_strings}
+    bad = set(overrides) - flag_dests
+    if bad:
+        raise ValueError(
+            f"overrides name non-flag or unknown parser dest(s): "
+            f"{sorted(bad)} (positionals can't be probed for explicitness)"
+        )
+    probe = _argparse.Namespace(**{a.dest: _UNSET for a in parser._actions})
+    parser.parse_known_args(argv, namespace=probe)
     for name, value in overrides.items():
-        if getattr(args, name) == parser.get_default(name):
+        if getattr(probe, name, _UNSET) is _UNSET:
             setattr(args, name, value)
 
 

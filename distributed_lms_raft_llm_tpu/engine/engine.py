@@ -62,8 +62,8 @@ class EngineConfig:
     fused_attention: Optional[bool] = None
     # Weight-only int8 ("int8") halves the parameter bytes the decode loop
     # streams per step (models/quant.py) — the dominant cost on the bench
-    # chip. None = full-precision (bf16) weights. Requires tp=1: the
-    # partition rules don't cover the quantized leaf pairs.
+    # chip. None = full-precision (bf16) weights. Composes with tp>1 (the
+    # partition rules shard the quantized {q, s} leaf pairs).
     quant: Optional[str] = None
     # int8 KV cache (per-slot scales, models/common.quantize_kv): halves
     # the attention bytes per decode step. Orthogonal to `quant`.
@@ -89,6 +89,14 @@ class TutoringEngine:
                 raise ValueError(
                     "fused_attention requires an unsharded (single-device) "
                     "mesh — the pallas kernel is not partition-aware"
+                )
+            if config.kv_quant:
+                # Fail at construction, not as a jit traceback at first
+                # warmup/generate (the kernel reads a bf16 cache layout).
+                raise ValueError(
+                    "fused_attention and kv_quant are mutually exclusive: "
+                    "the pallas decode kernel reads the full-precision "
+                    "cache layout"
                 )
             self.cfg = dataclasses.replace(self.cfg, fused_decode_attention=True)
         if config.kv_quant:
@@ -130,11 +138,9 @@ class TutoringEngine:
         if config.quant:
             if config.quant != "int8":
                 raise ValueError(f"unsupported quant mode {config.quant!r}")
-            if config.tp != 1:
-                raise ValueError(
-                    "quant='int8' requires tp=1 (partition rules cover "
-                    "dense leaves only)"
-                )
+            # Composes with tp: the partition rules cover the quantized
+            # {q, s} leaf pairs (parallel/partition.py) — q shards like the
+            # dense leaf, scales follow their out-channel axis.
             params = quant.quantize_params(params, self.family.name)
         rules = partition.RULES_FOR[self.family.name]
         self.params = partition.shard_tree(params, self.mesh, rules)

@@ -63,8 +63,6 @@ class RelevanceGate:
         if config.quant:
             if config.quant != "int8":
                 raise ValueError(f"unsupported quant mode {config.quant!r}")
-            if config.tp != 1:
-                raise ValueError("quant='int8' requires tp=1")
             from ..models import quant as quant_lib
 
             params = quant_lib.quantize_params(params, "bert")

@@ -202,6 +202,14 @@ class PagedEngine:
         )
         if config.kv_quant:
             self.cfg = dataclasses.replace(self.cfg, quant_kv=True)
+        if config.fused_attention:
+            # The pallas decode kernel reads the bucketed engine's cache
+            # layout (scalar length); the paged per-slot ragged offsets are
+            # not supported — fail loudly instead of silently using XLA.
+            raise ValueError(
+                "fused_attention is not supported by the paged engine "
+                "(per-slot ragged cache offsets); use TutoringEngine"
+            )
         self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1},
                                        devices=devices)
         self.tokenizer = tok_lib.load_gpt2_tokenizer(
@@ -234,8 +242,6 @@ class PagedEngine:
         if config.quant:
             if config.quant != "int8":
                 raise ValueError(f"unsupported quant mode {config.quant!r}")
-            if config.tp != 1:
-                raise ValueError("quant='int8' requires tp=1")
             params = quant_lib.quantize_params(params, self.family.name)
         rules = partition.RULES_FOR[self.family.name]
         self.params = partition.shard_tree(params, self.mesh, rules)

@@ -25,16 +25,29 @@ P = PartitionSpec
 Rules = Sequence[Tuple[str, PartitionSpec]]
 
 # GPT-2 family (stacked blocks; layer axis first, replicated).
+#
+# Weight-only int8 (models/quant.py) replaces a dense leaf `X` with the pair
+# `X/q` (int8, same shape) and `X/s` (f32 scales): `q` shards exactly like
+# the dense leaf; `s` is the leaf's shape minus the contracted `in` axis
+# (per-out-channel scales) — so column-parallel leaves shard their scales
+# over tp and row-parallel leaves replicate them (the scale applies after
+# the tp psum). Embedding tables scale per ROW (quantize_embedding), so
+# their `s` is [V], vocab-sharded like `q`'s leading axis.
 GPT2_RULES: List[Tuple[str, PartitionSpec]] = [
-    (r"wte$", P("tp", None)),            # vocab-sharded embedding
+    (r"wte(/q)?$", P("tp", None)),       # vocab-sharded embedding
+    (r"wte/s$", P("tp")),
     (r"wpe$", P(None, None)),
-    (r"blocks/attn/wqkv$", P(None, None, "tp")),   # column parallel
+    (r"blocks/attn/wqkv(/q)?$", P(None, None, "tp")),   # column parallel
+    (r"blocks/attn/wqkv/s$", P(None, "tp")),
     (r"blocks/attn/bqkv$", P(None, "tp")),
-    (r"blocks/attn/wo$", P(None, "tp", None)),     # row parallel
+    (r"blocks/attn/wo(/q)?$", P(None, "tp", None)),     # row parallel
+    (r"blocks/attn/wo/s$", P(None, None)),
     (r"blocks/attn/bo$", P(None, None)),
-    (r"blocks/mlp/wi$", P(None, None, "tp")),
+    (r"blocks/mlp/wi(/q)?$", P(None, None, "tp")),
+    (r"blocks/mlp/wi/s$", P(None, "tp")),
     (r"blocks/mlp/bi$", P(None, "tp")),
-    (r"blocks/mlp/wo$", P(None, "tp", None)),
+    (r"blocks/mlp/wo(/q)?$", P(None, "tp", None)),
+    (r"blocks/mlp/wo/s$", P(None, None)),
     (r"blocks/mlp/bo$", P(None, None)),
     (r"ln|lnf", P()),                    # norms replicated
     (r".*", P()),
@@ -43,25 +56,35 @@ GPT2_RULES: List[Tuple[str, PartitionSpec]] = [
 # Llama family: Megatron TP like GPT-2; q/k/v/gate/up column-parallel,
 # o/down row-parallel; untied vocab-sharded embed + lm_head.
 LLAMA_RULES: List[Tuple[str, PartitionSpec]] = [
-    (r"embed$", P("tp", None)),
-    (r"lm_head$", P("tp", None)),
-    (r"blocks/attn/w[qkv]$", P(None, None, "tp")),
-    (r"blocks/attn/wo$", P(None, "tp", None)),
-    (r"blocks/mlp/w[gu]$", P(None, None, "tp")),
-    (r"blocks/mlp/wd$", P(None, "tp", None)),
+    (r"embed(/q)?$", P("tp", None)),
+    (r"embed/s$", P("tp")),
+    (r"lm_head(/q)?$", P("tp", None)),
+    (r"lm_head/s$", P("tp")),
+    (r"blocks/attn/w[qkv](/q)?$", P(None, None, "tp")),
+    (r"blocks/attn/w[qkv]/s$", P(None, "tp")),
+    (r"blocks/attn/wo(/q)?$", P(None, "tp", None)),
+    (r"blocks/attn/wo/s$", P(None, None)),
+    (r"blocks/mlp/w[gu](/q)?$", P(None, None, "tp")),
+    (r"blocks/mlp/w[gu]/s$", P(None, "tp")),
+    (r"blocks/mlp/wd(/q)?$", P(None, "tp", None)),
+    (r"blocks/mlp/wd/s$", P(None, None)),
     (r"ln|lnf", P()),
     (r".*", P()),
 ]
 
 BERT_RULES: List[Tuple[str, PartitionSpec]] = [
-    (r"embeddings/word$", P("tp", None)),
+    (r"embeddings/word(/q)?$", P("tp", None)),
+    (r"embeddings/word/s$", P("tp")),
     (r"embeddings/(position|token_type)$", P(None, None)),
-    (r"blocks/attn/wqkv$", P(None, None, "tp")),
+    (r"blocks/attn/wqkv(/q)?$", P(None, None, "tp")),
+    (r"blocks/attn/wqkv/s$", P(None, "tp")),
     (r"blocks/attn/bqkv$", P(None, "tp")),
-    (r"blocks/attn/wo$", P(None, "tp", None)),
-    (r"blocks/mlp/wi$", P(None, None, "tp")),
-    (r"blocks/mlp/bi$", P(None, "tp")),
-    (r"blocks/mlp/wo$", P(None, "tp", None)),
+    (r"blocks/attn/wo(/q)?$", P(None, "tp", None)),
+    (r"blocks/attn/wo/s$", P(None, None)),
+    (r"blocks/mlp/wi(/q)?$", P(None, None, "tp")),
+    (r"blocks/mlp/wi/s$", P(None, "tp")),
+    (r"blocks/mlp/wo(/q)?$", P(None, "tp", None)),
+    (r"blocks/mlp/wo/s$", P(None, None)),
     (r".*", P()),
 ]
 

@@ -217,7 +217,7 @@ def main(argv=None) -> None:
             "heartbeat_interval": cfg.cluster.heartbeat_interval,
             "metrics_period": cfg.cluster.metrics_period,
             "snapshot_every": cfg.cluster.snapshot_every,
-        })
+        }, argv=argv)
         if not args.no_linearizable_reads:
             args.linearizable_reads = cfg.cluster.linearizable_reads
     elif args.id is None or args.port is None or not args.peers:

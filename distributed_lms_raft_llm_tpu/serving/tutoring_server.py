@@ -210,11 +210,11 @@ def main(argv=None) -> None:
             "quant": t.quant, "max_new_tokens": s.max_new_tokens,
             "max_batch": t.max_batch, "max_wait_ms": t.max_wait_ms,
             "slots": t.slots, "auth_key_file": t.auth_key_file,
-            # store_true flags merge the same way: the parser default is
-            # False, so an explicit flag wins and the file fills the rest.
+            # store_true flags merge the same way: presence in argv is what
+            # marks them explicit, so the file fills only absent ones.
             "kv_quant": t.kv_quant, "paged": t.paged,
             "approx_topk": s.approx_top_k,
-        })
+        }, argv=argv)
         args.sampling_overrides = dict(
             temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
             repetition_penalty=s.repetition_penalty,

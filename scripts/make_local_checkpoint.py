@@ -26,16 +26,17 @@ import glob
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 
 def build_corpus(out_path: str, max_files: int = 400) -> str:
     """Concatenate local prose/code into a BPE training corpus."""
     sources: list[str] = []
     for pattern in (
-        "/root/repo/*.md",
-        "/root/repo/distributed_lms_raft_llm_tpu/**/*.py",
-        "/root/repo/tests/*.py",
+        f"{REPO}/*.md",
+        f"{REPO}/distributed_lms_raft_llm_tpu/**/*.py",
+        f"{REPO}/tests/*.py",
         "/usr/lib/python3*/[a-z]*.py",
         "/usr/share/doc/**/*.txt",
     ):
@@ -51,28 +52,68 @@ def build_corpus(out_path: str, max_files: int = 400) -> str:
     return out_path
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="data/gpt2-local")
-    ap.add_argument("--model", default="gpt2",
-                    choices=["gpt2", "gpt2-medium", "gpt2-large"])
-    ap.add_argument("--vocab-size", type=int, default=50257)
-    ap.add_argument("--seed", type=int, default=0)
-    args = ap.parse_args()
+def build_bert_local(out_dir: str, seed: int = 0,
+                     vocab_size: int = 30522) -> None:
+    """data/bert-local: WordPiece vocab.txt trained on local text + a
+    full-size HF-layout BertModel `.safetensors` (seeded random weights)
+    consumed through the identical `convert.bert_params_from_hf` path the
+    gate uses for real pretrained weights. Reference analogue:
+    GUI_RAFT_LLM_SourceCode/lms_server.py:1258-1260 (`bert-base-uncased`
+    loaded for the relevance gate)."""
+    os.makedirs(out_dir, exist_ok=True)
+    ckpt = os.path.join(out_dir, "model.safetensors")
+    vocab = os.path.join(out_dir, "vocab.txt")
 
-    os.makedirs(args.out, exist_ok=True)
-    ckpt = os.path.join(args.out, "model.safetensors")
-    vocab = os.path.join(args.out, "vocab.json")
-    merges = os.path.join(args.out, "merges.txt")
+    if not os.path.exists(vocab):
+        import tokenizers
+
+        corpus = build_corpus(os.path.join(out_dir, "corpus.txt"))
+        wp = tokenizers.BertWordPieceTokenizer(lowercase=True)
+        wp.train([corpus], vocab_size=vocab_size, min_frequency=2,
+                 special_tokens=["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"])
+        wp.save_model(out_dir)
+        os.remove(corpus)
+        print(f"trained WordPiece vocab: {wp.get_vocab_size()} tokens -> {vocab}")
+
+    if not os.path.exists(ckpt):
+        import torch
+        import transformers
+
+        from distributed_lms_raft_llm_tpu.models import convert
+
+        torch.manual_seed(seed)
+        model = transformers.BertModel(
+            transformers.BertConfig()  # bert-base-uncased architecture
+        )
+        sd = {
+            k: v.detach().cpu().numpy()
+            for k, v in model.state_dict().items()
+            if not k.startswith("pooler.")  # mean-pooled gate: pooler unused
+        }
+        convert.save_safetensors(ckpt, sd)
+        n = sum(v.size for v in sd.values())
+        print(f"wrote bert-base checkpoint: {n/1e6:.0f}M params -> {ckpt}")
+
+
+def build_gpt2_local(out_dir: str, model: str = "gpt2", seed: int = 0,
+                     vocab_size: int = 50257) -> None:
+    """data/gpt2-local: byte-level BPE vocab/merges trained on local text +
+    a full-size HF-layout GPT2LMHeadModel `.safetensors` (seeded random
+    weights) consumed through the identical `convert.gpt2_params_from_hf`
+    path pretrained weights use."""
+    os.makedirs(out_dir, exist_ok=True)
+    ckpt = os.path.join(out_dir, "model.safetensors")
+    vocab = os.path.join(out_dir, "vocab.json")
+    merges = os.path.join(out_dir, "merges.txt")
 
     if not (os.path.exists(vocab) and os.path.exists(merges)):
         import tokenizers
 
-        corpus = build_corpus(os.path.join(args.out, "corpus.txt"))
+        corpus = build_corpus(os.path.join(out_dir, "corpus.txt"))
         bpe = tokenizers.ByteLevelBPETokenizer()
-        bpe.train([corpus], vocab_size=args.vocab_size, min_frequency=2,
+        bpe.train([corpus], vocab_size=vocab_size, min_frequency=2,
                   special_tokens=["<|endoftext|>"])
-        bpe.save_model(args.out)
+        bpe.save_model(out_dir)
         os.remove(corpus)
         print(f"trained BPE vocab: {bpe.get_vocab_size()} tokens -> {vocab}")
 
@@ -86,17 +127,34 @@ def main() -> None:
             "gpt2": dict(),
             "gpt2-medium": dict(n_embd=1024, n_layer=24, n_head=16),
             "gpt2-large": dict(n_embd=1280, n_layer=36, n_head=20),
-        }[args.model]
-        torch.manual_seed(args.seed)
-        model = transformers.GPT2LMHeadModel(transformers.GPT2Config(**arch))
+        }[model]
+        torch.manual_seed(seed)
+        hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(**arch))
         sd = {
             k: v.detach().cpu().numpy()
-            for k, v in model.state_dict().items()
+            for k, v in hf.state_dict().items()
             if k != "lm_head.weight"  # tied to wte
         }
         convert.save_safetensors(ckpt, sd)
         n = sum(v.size for v in sd.values())
-        print(f"wrote {args.model} checkpoint: {n/1e6:.0f}M params -> {ckpt}")
+        print(f"wrote {model} checkpoint: {n/1e6:.0f}M params -> {ckpt}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data/gpt2-local")
+    ap.add_argument("--model", default="gpt2",
+                    choices=["gpt2", "gpt2-medium", "gpt2-large"])
+    ap.add_argument("--vocab-size", type=int, default=50257)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--bert-out", default="data/bert-local",
+                    help="BERT gate artifact directory ('' skips)")
+    args = ap.parse_args()
+
+    if args.bert_out:
+        build_bert_local(args.bert_out, seed=args.seed)
+    build_gpt2_local(args.out, model=args.model, seed=args.seed,
+                     vocab_size=args.vocab_size)
 
 
 if __name__ == "__main__":

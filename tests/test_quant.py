@@ -157,14 +157,114 @@ def test_paged_engine_serves_quantized():
     assert all(isinstance(t, str) for t in out.values())
 
 
-def test_engine_quant_requires_tp1():
+def test_quantized_partition_rules_cover_qs_pairs():
+    """The {q, s} leaf pairs of a quantized tree match real tp rules, not
+    the replicate-everything fallback: q shards like its dense leaf, scales
+    follow their out-channel axis (BASELINE config 4 — int8 under tp —
+    depends on these)."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_lms_raft_llm_tpu.parallel import partition
+
+    cfg = gpt2.GPT2Config.tiny()
+    qparams = quant.quantize_params(gpt2.init_params(jax.random.key(0), cfg),
+                                    "gpt2")
+    specs = partition.match_partition_rules(partition.GPT2_RULES, qparams)
+    assert specs["wte"]["q"] == P("tp", None)
+    assert specs["wte"]["s"] == P("tp")
+    blk = specs["blocks"]
+    assert blk["attn"]["wqkv"]["q"] == P(None, None, "tp")
+    assert blk["attn"]["wqkv"]["s"] == P(None, "tp")
+    assert blk["attn"]["wo"]["q"] == P(None, "tp", None)
+    assert blk["attn"]["wo"]["s"] == P(None, None)
+    assert blk["mlp"]["wi"]["q"] == P(None, None, "tp")
+    assert blk["mlp"]["wi"]["s"] == P(None, "tp")
+    assert blk["mlp"]["wo"]["q"] == P(None, "tp", None)
+    assert blk["mlp"]["wo"]["s"] == P(None, None)
+
+
+def test_int8_tp_sharded_logits_match_unsharded():
+    """int8 weights under tp=4: the sharded forward reproduces the
+    single-device quantized forward (same quantized params, f32 math)."""
+    import dataclasses
+
+    from distributed_lms_raft_llm_tpu.parallel import mesh as mesh_lib
+    from distributed_lms_raft_llm_tpu.parallel import partition
+
+    cfg = dataclasses.replace(
+        gpt2.GPT2Config(dtype=jnp.float32, param_dtype=jnp.float32),
+        hidden_size=64, num_layers=3, num_heads=8,
+        vocab_size=512, max_position_embeddings=64,
+    )
+    qparams = quant.quantize_params(gpt2.init_params(jax.random.key(3), cfg),
+                                    "gpt2")
+    ids = jnp.asarray(
+        np.random.default_rng(9).integers(1, cfg.vocab_size, (2, 12)),
+        jnp.int32,
+    )
+    ref, _ = gpt2.forward(qparams, cfg, ids)
+
+    mesh = mesh_lib.make_mesh({"tp": 4, "dp": -1})
+    sharded = partition.shard_tree(qparams, mesh, partition.GPT2_RULES)
+    with mesh:
+        got, _ = jax.jit(lambda p, i: gpt2.forward(p, cfg, i))(sharded, ids)
+    err = float(jnp.max(jnp.abs(ref - got)))
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert err / scale < 1e-5, f"tp=4 int8 logits diverge: {err}"
+
+
+def test_int8_tp8_uneven_gpt2_large_topology_decode():
+    """BASELINE config 4's production quant: int8 weights + int8 KV under
+    tp=8 with GPT-2-large's uneven head topology (20 % 8 != 0)."""
+    import dataclasses
+
+    from distributed_lms_raft_llm_tpu.engine import generate as gen
+    from distributed_lms_raft_llm_tpu.parallel import mesh as mesh_lib
+    from distributed_lms_raft_llm_tpu.parallel import partition
+
+    cfg = dataclasses.replace(
+        gpt2.GPT2Config.large(dtype=jnp.float32, param_dtype=jnp.float32),
+        hidden_size=80,   # 20 heads x 4 head_dim (true: 20 x 64)
+        num_layers=4,
+        vocab_size=512,
+        max_position_embeddings=64,
+        quant_kv=True,
+    )
+    qparams = quant.quantize_params(gpt2.init_params(jax.random.key(4), cfg),
+                                    "gpt2")
+    mesh = mesh_lib.make_mesh({"tp": 8, "dp": -1})
+    sharded = partition.shard_tree(qparams, mesh, partition.GPT2_RULES)
+    ids = np.ones((2, 16), np.int32)
+    mask = np.ones((2, 16), bool)
+    with mesh:
+        result = jax.jit(
+            lambda p, i, m, r: gen.generate(
+                p, cfg, i, m, r,
+                sampling=SamplingParams.reference_defaults(max_new_tokens=4),
+                eos_id=0, pad_id=0,
+            )
+        )(sharded, jnp.asarray(ids), jnp.asarray(mask), jax.random.key(5))
+    result = jax.device_get(result)
+    assert result.tokens.shape == (2, 4)
+    assert (result.tokens < cfg.vocab_size).all()
+    assert np.isfinite(result.lengths).all()
+
+
+def test_engine_int8_tp2_serves():
+    """TutoringEngine with quant='int8', tp=2 boots and answers (the
+    combination the round-4 guard rejected)."""
     from distributed_lms_raft_llm_tpu.engine import EngineConfig, TutoringEngine
 
-    sampling = SamplingParams.reference_defaults(max_new_tokens=16)
-    with pytest.raises(ValueError, match="tp=1"):
-        TutoringEngine(
-            EngineConfig(model="tiny", quant="int8", tp=2, sampling=sampling)
+    eng = TutoringEngine(
+        EngineConfig(
+            model="tiny", quant="int8", kv_quant=True, tp=2,
+            sampling=SamplingParams.reference_defaults(max_new_tokens=6),
+            length_buckets=(16,), batch_buckets=(1, 2),
         )
+    )
+    answers = eng.answer_batch(["what is raft?", "explain paxos"])
+    assert len(answers) == 2
+    assert all(isinstance(a, str) for a in answers)
 
 
 def test_bert_gate_quantized_similarity_close():
