@@ -68,6 +68,11 @@ class EngineConfig:
     # int8 KV cache (per-slot scales, models/common.quantize_kv): halves
     # the attention bytes per decode step. Orthogonal to `quant`.
     kv_quant: bool = False
+    # Decode-segment count: the KV cache grows to each segment's high-water
+    # mark instead of being final-size from step one, so attention streams
+    # only slots that can be valid yet (generate.decode; measured numbers
+    # in BENCH_NOTES.md). 1 = single full-size while_loop.
+    decode_segments: int = 4
     dtype: Any = jnp.bfloat16
     # Serving stores weights in bf16: halves the HBM read per decode step
     # versus f32 (the decode loop is memory-bound — every step streams all
@@ -160,7 +165,10 @@ class TutoringEngine:
             model=self.family,
         )
         self._prefill = jax.jit(partial(prefill, **statics))
-        self._decode = jax.jit(partial(decode, **statics), donate_argnums=(1,))
+        self._decode = jax.jit(
+            partial(decode, segments=config.decode_segments, **statics),
+            donate_argnums=(1,),
+        )
         self.last_ttft_s: Optional[float] = None
         self.last_batch_ttfts: List[float] = []
 
@@ -237,8 +245,9 @@ class TutoringEngine:
             if measure_ttft:
                 np.asarray(state.out[:, 0])  # blocks until the first token exists
                 self.last_ttft_s = time.monotonic() - t0
-            # The final state is returned (and dropped) purely so the donated
-            # input state aliases into same-shaped outputs — see decode().
+            # The final state is returned (and dropped) so the donated input
+            # state's same-shaped buffers (out/seen/rng/flags) alias into the
+            # outputs; the cache intentionally grows instead — see decode().
             result, _ = self._decode(self.params, state)
         return result if device_result else jax.device_get(result)
 

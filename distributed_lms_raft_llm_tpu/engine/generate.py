@@ -15,9 +15,18 @@ measure time-to-first-token for real instead of deriving it:
   The state (KV cache included) is donated by the engine's jit wrapper, so
   the handoff between the two programs reuses the cache buffers in place.
 
-Shapes are static: prompts are left-padded to a bucket length; the cache is
-sized exactly `bucket + max_new_tokens` so the precondition documented in
-models/gpt2.py (no silent cache overflow) holds by construction.
+Shapes are static: prompts are left-padded to a bucket length. The KV cache
+GROWS across decode segments instead of being allocated at its final size up
+front: prefill builds a prompt-sized cache, and `decode` splits the token
+budget into `segments` spans, padding the cache to each span's high-water
+mark between the spans' while_loops. Every attention/softmax/scale op's
+cost is proportional to the cache length it reads, and with a 64-token
+prompt and 128 new tokens the final-size cache wastes ~1/3 of that traffic
+on slots that are not valid yet (measured 47% of the batch-32 decode step —
+profiles/decode_int8w_int8kv_r5_batch32.json); growing it in 4 segments
+recovers most of the waste for a few cheap pad-copies. The last segment's
+cache is exactly `bucket + max_new_tokens`, so the no-silent-overflow
+precondition documented in models/gpt2.py still holds by construction.
 
 The reference caps *total* length at 150 (`max_length`), which silently
 leaves no room to answer long prompts (SURVEY.md §5 latent defect); here the
@@ -43,18 +52,26 @@ class GenerateResult(NamedTuple):
 
 
 class DecodeState(NamedTuple):
-    """Carry between the prefill and decode programs (and loop iterations)."""
+    """Carry between the prefill and decode programs (and loop iterations).
+
+    The cache is prompt-sized coming out of `prefill`; `decode` pads it to
+    each segment's high-water mark (see module docstring). `seen` stays the
+    dense [B, V] presence plane: a transcript-ids + scatter-min variant was
+    measured SLOWER (+~120 µs/step at batch 32 — TPU scatter serializes;
+    the one_hot|or update and fused mask read cost ~20 µs — see
+    BENCH_NOTES.md round-5 negative results).
+    """
 
     cache: KVCache
     tok: jax.Array        # [B] last sampled token
     rng: jax.Array
     out: jax.Array        # [B, max_new]
-    seen: jax.Array       # [B, V]
+    seen: jax.Array       # [B, V] repetition-penalty presence mask
     done: jax.Array       # [B]
     lengths: jax.Array    # [B]
     step: jax.Array       # []
     real_lens: jax.Array  # [B] true prompt lengths (positions base)
-    kv_mask: jax.Array    # [B, cache_len] key-slot validity
+    kv_mask: jax.Array    # [B, t + max_new] key-slot validity (full width)
 
 
 def make_positions(prompt_mask: jax.Array) -> jax.Array:
@@ -87,24 +104,24 @@ def prefill(
             f"bucket {t} + max_new {max_new} exceeds position table "
             f"{cfg.max_position_embeddings}"
         )
-    cache_len = t + max_new
-    vocab = cfg.vocab_size
 
     positions = make_positions(prompt_mask)
     real_lens = jnp.sum(prompt_mask.astype(jnp.int32), axis=1)  # [B]
 
-    cache = model.init_cache(cfg, b, cache_len, dtype=cfg.dtype)
+    # Prompt-sized cache: decode pads it up per segment (module docstring).
+    cache = model.init_cache(cfg, b, t, dtype=cfg.dtype)
     # Slots 0..t-1 hold the (partly padded) prompt; decode slots are real.
     kv_mask = jnp.concatenate(
         [prompt_mask.astype(jnp.bool_), jnp.ones((b, max_new), jnp.bool_)], axis=1
     )
 
     logits, cache = model.forward(
-        params, cfg, input_ids, cache=cache, positions=positions, kv_mask=kv_mask
+        params, cfg, input_ids, cache=cache, positions=positions,
+        kv_mask=kv_mask[:, :t],
     )
     last_logits = logits[:, -1]  # left-padding ⇒ every row's last slot is real
 
-    seen = seen_mask_from_ids(input_ids, prompt_mask, vocab)
+    seen = seen_mask_from_ids(input_ids, prompt_mask, cfg.vocab_size)
 
     rng, step_rng = jax.random.split(rng)
     first_tok = sample_step(step_rng, last_logits, seen, sampling)
@@ -125,6 +142,20 @@ def prefill(
     )
 
 
+def _grow_cache(cache: KVCache, new_len: int) -> KVCache:
+    """Zero-pad the key/value slot axis up to `new_len` (no-op if there)."""
+    cur = cache.k.shape[3]
+    if cur >= new_len:
+        return cache
+    pad = [(0, 0), (0, 0), (0, 0), (0, new_len - cur), (0, 0)]
+    return cache._replace(
+        k=jnp.pad(cache.k, pad),
+        v=jnp.pad(cache.v, pad),
+        ks=None if cache.ks is None else jnp.pad(cache.ks, pad[:-1]),
+        vs=None if cache.vs is None else jnp.pad(cache.vs, pad[:-1]),
+    )
+
+
 def decode(
     params,
     state: DecodeState,
@@ -133,51 +164,73 @@ def decode(
     eos_id: int,
     pad_id: int,
     model: ModelFamily = registry.GPT2_FAMILY,
+    segments: int = 4,
 ) -> Tuple[GenerateResult, DecodeState]:
     """Run the while_loop decode from a prefilled state to completion.
 
-    Returns (result, final_state). The final state is returned so that when
-    the engine's jit wrapper donates the input state, every donated buffer
-    (KV cache included) has a same-shaped output to alias into — without it
-    XLA has nothing to alias the 100-MB-class cache against and copies it at
-    the prefill→decode handoff ("donated buffers were not usable" warnings,
-    measured ~15% of decode wall time at batch 8). Callers that only want
-    the tokens drop the state; the buffers free when the reference does.
+    The token budget splits into `segments` spans; each span runs its own
+    while_loop against a cache padded to that span's high-water mark, so
+    attention streams only the slots that can be valid yet (module
+    docstring — measured ~47% of the batch-32 step was full-size KV reads).
+    A fully-EOS'd batch exits at the next span boundary: each span's cond
+    starts false, so trailing spans cost one predicate each.
+
+    Returns (result, final_state). The final state is returned so the
+    engine's jit wrapper can donate the input state: the same-shaped
+    outputs (out/seen/rng/flags) alias in place instead of copying. The
+    cache cannot alias at any segments setting — the input is prompt-sized,
+    the output [*, t + max_new] — but the copies that implies are the pads,
+    already counted in the segmentation tradeoff. Callers that only want
+    the tokens drop the state.
     """
     max_new = sampling.max_new_tokens
+    t = state.kv_mask.shape[1] - max_new
+    segments = max(1, min(segments, max_new))
 
-    def cond(s: DecodeState):
-        return (s.step < max_new) & ~jnp.all(s.done)
+    def seg_body(seg_end: int):
+        def cond(s: DecodeState):
+            return (s.step < seg_end) & ~jnp.all(s.done)
 
-    def body(s: DecodeState) -> DecodeState:
-        # Feed last token; its slot is t + step - 1, its position is
-        # real_lens + step - 1 (both per the left-padded layout).
-        pos = (s.real_lens + s.step - 1)[:, None]
-        logits, cache = model.forward(
-            params, cfg, s.tok[:, None], cache=s.cache, positions=pos,
-            kv_mask=s.kv_mask,
-        )
-        rng, step_rng = jax.random.split(s.rng)
-        nxt = sample_step(step_rng, logits[:, 0], s.seen, sampling)
-        nxt = jnp.where(s.done, jnp.asarray(pad_id, jnp.int32), nxt)
-        out = jax.lax.dynamic_update_slice(s.out, nxt[:, None], (0, s.step))
-        lengths = s.lengths + (~s.done).astype(jnp.int32)
-        done = s.done | (nxt == eos_id)
-        return DecodeState(
-            cache=cache,
-            tok=nxt,
-            rng=rng,
-            out=out,
-            seen=update_seen(s.seen, nxt),
-            done=done,
-            lengths=lengths,
-            step=s.step + 1,
-            real_lens=s.real_lens,
-            kv_mask=s.kv_mask,
-        )
+        def body(s: DecodeState) -> DecodeState:
+            # Feed last token; its slot is t + step - 1, its position is
+            # real_lens + step - 1 (both per the left-padded layout).
+            pos = (s.real_lens + s.step - 1)[:, None]
+            n_keys = s.cache.k.shape[3]
+            logits, cache = model.forward(
+                params, cfg, s.tok[:, None], cache=s.cache, positions=pos,
+                kv_mask=s.kv_mask[:, :n_keys],
+            )
+            rng, step_rng = jax.random.split(s.rng)
+            nxt = sample_step(step_rng, logits[:, 0], s.seen, sampling)
+            nxt = jnp.where(s.done, jnp.asarray(pad_id, jnp.int32), nxt)
+            out = jax.lax.dynamic_update_slice(s.out, nxt[:, None], (0, s.step))
+            lengths = s.lengths + (~s.done).astype(jnp.int32)
+            done = s.done | (nxt == eos_id)
+            return DecodeState(
+                cache=cache,
+                tok=nxt,
+                rng=rng,
+                out=out,
+                seen=update_seen(s.seen, nxt),
+                done=done,
+                lengths=lengths,
+                step=s.step + 1,
+                real_lens=s.real_lens,
+                kv_mask=s.kv_mask,
+            )
 
-    final = jax.lax.while_loop(cond, body, state)
-    return GenerateResult(tokens=final.out, lengths=final.lengths), final
+        return cond, body
+
+    for i in range(segments):
+        seg_end = (max_new * (i + 1)) // segments
+        # Steps in [.., seg_end) feed cache slots up to t + seg_end - 2 and
+        # the span's last sampled token lands at slot t + seg_end - 1 next
+        # span — pad to t + seg_end so the NEXT span's first step fits too.
+        state = state._replace(cache=_grow_cache(state.cache, t + seg_end))
+        cond, body = seg_body(seg_end)
+        state = jax.lax.while_loop(cond, body, state)
+
+    return GenerateResult(tokens=state.out, lengths=state.lengths), state
 
 
 def generate(

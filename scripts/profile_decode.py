@@ -1,0 +1,233 @@
+"""Per-op device-time breakdown of one int8 decode call, fusion-correlated.
+
+Round-4's profile (profiles/decode_int8_r4.json) named the costly fusions
+but not what is INSIDE them, so the ~3x headroom between batch-32 effective
+parameter streaming (~92 GB/s) and the measured ~275 GB/s ceiling stayed
+unexplained. This script closes that gap:
+
+1. runs one `generate_ids` (prefill + 128-step while_loop decode) under
+   `jax.profiler.trace` and aggregates the device lane per op;
+2. lowers/compiles the same decode program and extracts each hot fusion's
+   fused-computation body from the optimized HLO, so every `fusion.N` line
+   in the output carries the opcodes (and the largest tensor shapes) it
+   executes;
+3. writes profiles/decode_int8_r5_batch<B>.json.
+
+Usage: python scripts/profile_decode.py [--batch 8] [--bf16] [--out ...]
+
+(Methodology per BENCH_NOTES.md: `block_until_ready` does not sync on the
+axon backend — every timed region ends in a host readback.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_engine(batch: int, quant: bool):
+    import jax.numpy as jnp
+
+    from distributed_lms_raft_llm_tpu.engine import (
+        EngineConfig, SamplingParams, TutoringEngine,
+    )
+
+    ckpt_dir = os.path.join(REPO, "data", "gpt2-local")
+    cfg = EngineConfig(
+        model="gpt2",
+        checkpoint=os.path.join(ckpt_dir, "model.safetensors"),
+        vocab_path=os.path.join(ckpt_dir, "vocab.json"),
+        merges_path=os.path.join(ckpt_dir, "merges.txt"),
+        sampling=SamplingParams.reference_defaults(max_new_tokens=128),
+        quant="int8" if quant else None,
+        kv_quant=quant,
+        batch_buckets=(batch,),
+        length_buckets=(64,),
+    )
+    return TutoringEngine(cfg)
+
+
+def trace_events(trace_dir: str):
+    """Load every *.trace.json.gz under trace_dir; yield complete events."""
+    for path in glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    ):
+        with gzip.open(path, "rt") as fh:
+            data = json.load(fh)
+        names = {}  # (pid, tid) -> lane name from metadata events
+        pids = {}
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+                names[(ev.get("pid"), ev.get("tid"))] = ev["args"]["name"]
+            elif ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pids[ev.get("pid")] = ev["args"]["name"]
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") == "X":
+                lane = names.get((ev.get("pid"), ev.get("tid")), "")
+                proc = pids.get(ev.get("pid"), "")
+                yield proc, lane, ev
+
+
+def aggregate_device_ops(trace_dir: str):
+    """Sum device-lane op durations by name; return (total_ms, [op rows])."""
+    per_op = collections.Counter()
+    per_op_count = collections.Counter()
+    for proc, lane, ev in trace_events(trace_dir):
+        # Device lanes are under the TPU/device process, XLA Ops threads.
+        text = f"{proc}/{lane}".lower()
+        if "xla op" not in text and "tensorflow op" not in text:
+            continue
+        name = ev.get("name", "?")
+        per_op[name] += ev.get("dur", 0) / 1000.0  # us -> ms
+        per_op_count[name] += 1
+    rows = [
+        {"op": op, "ms": round(ms, 3), "count": per_op_count[op]}
+        for op, ms in per_op.most_common()
+    ]
+    return round(sum(per_op.values()), 2), rows
+
+
+def fusion_bodies(hlo_text: str):
+    """Map fusion instruction name -> opcode summary of its computation.
+
+    Optimized HLO prints `%name = ... fusion(...), kind=..., calls=%comp`;
+    each `%comp` is a computation block whose instruction opcodes tell us
+    what the fusion actually does (scatter, iota-compare, reduce, dot...).
+    """
+    # computation name -> list of "opcode shape" strings
+    comps: dict[str, list[str]] = {}
+    current = None
+    for line in hlo_text.splitlines():
+        m = re.match(r"\s*%?([\w\.\-]+)\s*\([^)]*\)\s*->\s*.*{\s*$", line)
+        if m:
+            current = m.group(1)
+            comps[current] = []
+            continue
+        if current is not None:
+            if line.strip() == "}":
+                current = None
+                continue
+            im = re.match(
+                r"\s*(?:ROOT\s+)?%?[\w\.\-]+\s*=\s*(\(.*?\)|\S+)\s+([\w\-]+)",
+                line,
+            )
+            if im:
+                comps[current].append(f"{im.group(2)} {im.group(1)}")
+    # fusion instr -> calls= (line-based: shapes nest parens/braces — e.g.
+    # tuple outputs with T(8,128) tilings — so a single regex over the whole
+    # instruction is fragile)
+    fus = {}
+    for line in hlo_text.splitlines():
+        if " fusion(" not in line or "calls=" not in line:
+            continue
+        nm = re.match(r"\s*(?:ROOT\s+)?%?([\w\.\-]+)\s*=", line)
+        cm = re.search(r"calls=%?([\w\.\-]+)", line)
+        if nm and cm:
+            fus[nm.group(1)] = cm.group(1)
+    out = {}
+    for name, comp in fus.items():
+        ops = comps.get(comp, [])
+        # Opcode histogram + the biggest shapes, compact.
+        hist = collections.Counter(o.split()[0] for o in ops)
+        big = sorted(
+            (o for o in ops if "[" in o),
+            key=lambda o: -eval_size(o.split()[1]),
+        )[:4]
+        out[name] = {
+            "opcodes": dict(hist.most_common()),
+            "largest": big,
+        }
+    return out
+
+
+def eval_size(shape: str) -> int:
+    m = re.search(r"\[([\d,]*)\]", shape)
+    if not m or not m.group(1):
+        return 0
+    n = 1
+    for d in m.group(1).split(","):
+        n *= int(d)
+    return n
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--bf16", action="store_true",
+                    help="profile the bf16 config instead of int8+int8kv")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--trace-dir", default="/tmp/decode_trace")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    eng = build_engine(args.batch, quant=not args.bf16)
+    ids = np.zeros((args.batch, 64), np.int32)
+    mask = np.ones((args.batch, 64), bool)
+    eng.generate_ids(ids, mask)  # compile + warm
+    import shutil
+
+    shutil.rmtree(args.trace_dir, ignore_errors=True)
+    with jax.profiler.trace(args.trace_dir):
+        result = eng.generate_ids(ids, mask)  # device_get inside = sync
+    del result
+
+    total_ms, rows = aggregate_device_ops(args.trace_dir)
+
+    # HLO bodies for the decode program (the dominant while_loop lives
+    # there); prefill adds its own fusions — correlate against both.
+    import jax.numpy as jnp
+
+    with eng.mesh:
+        state = eng._prefill(
+            eng.params, input_ids=jnp.asarray(ids),
+            prompt_mask=jnp.asarray(mask), rng=jax.random.key(0),
+        )
+        lowered = eng._decode.lower(eng.params, state)
+        hlo = lowered.compile().as_text()
+    bodies = fusion_bodies(hlo)
+
+    for row in rows[:60]:
+        base = row["op"].split("(")[0]
+        if base in bodies:
+            row["hlo"] = bodies[base]
+
+    label = "bf16" if args.bf16 else "int8w_int8kv"
+    out_path = args.out or os.path.join(
+        REPO, "profiles", f"decode_{label}_r5_batch{args.batch}.json"
+    )
+    payload = {
+        "description": (
+            f"Device-time breakdown of ONE generate_ids call (64-token "
+            f"prompt prefill + 128-step decode), GPT-2-small batch "
+            f"{args.batch}, {label}; fusions annotated with their "
+            f"fused-computation opcode histograms from the optimized HLO "
+            f"of the decode program"
+        ),
+        "total_device_ms": total_ms,
+        "ops_ms": rows[:80],
+    }
+    with open(out_path, "w") as fh:
+        json.dump(payload, fh, indent=1)
+    print(f"wrote {out_path}  total_device_ms={total_ms}")
+    for row in rows[:12]:
+        extra = ""
+        if "hlo" in row:
+            extra = " " + ",".join(
+                f"{k}x{v}" for k, v in row["hlo"]["opcodes"].items()
+            )
+        print(f"  {row['ms']:9.2f} ms x{row['count']:<5} {row['op'][:60]}{extra[:90]}")
+
+
+if __name__ == "__main__":
+    main()
