@@ -72,6 +72,7 @@ class TutoringConfig:
     max_batch: int = 8
     max_wait_ms: float = 10.0
     slots: Optional[int] = None
+    chunk: int = 16              # paged: tokens per dispatched step program
     auth_key_file: Optional[str] = None
 
     @property

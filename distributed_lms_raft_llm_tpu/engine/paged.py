@@ -49,6 +49,7 @@ from ..parallel import partition
 from ..utils import tokenizer as tok_lib
 from ..utils.compilation import enable_compilation_cache
 from .engine import EngineConfig
+from .generate import pick_bucket
 from .sampling import (
     SamplingParams,
     sample_step,
@@ -75,18 +76,27 @@ class _Request:
     tokens: List[int]
     max_new: int
     submit_time: float = 0.0
+    # Set at reap time; later in-flight chunks dispatched before the finish
+    # was known still carry this request in their slot snapshot and must
+    # skip it (see PagedEngine.step pipelining).
+    finished: bool = False
 
 
 def _prefill_program(params, ids, true_len, rng, *, cfg, sampling, model):
     """[1, T] right-padded prompt -> (cache, first_tok, seen_row).
 
-    The returned cache is the single-slot cache [L, 1, H, Tmax, Dh] (plus
-    scale planes when int8-quantized) with the prompt occupying positions
-    0..true_len-1.
+    The returned cache is PROMPT-sized — [L, 1, H, T, Dh] for a T-token
+    prompt bucket (plus scale planes when int8-quantized), the prompt
+    occupying positions 0..true_len-1. `_install` splices it into the
+    slot's region of the live Tmax-wide cache (a dynamic_update_slice with
+    a smaller-than-operand update); the first generated token's KV lands
+    during the next step program. Prompt buckets therefore compile one
+    prefill program per length bucket, and a short prompt pays a short
+    prefill instead of the full Tmax one.
     """
     _, t = ids.shape
-    cache = model.init_cache(cfg, 1, cfg_tmax(cfg, sampling, t), dtype=cfg.dtype)
-    kv_mask = (jnp.arange(cache.k.shape[3]) < true_len)[None, :]
+    cache = model.init_cache(cfg, 1, t, dtype=cfg.dtype)
+    kv_mask = (jnp.arange(t) < true_len)[None, :]
     positions = jnp.minimum(jnp.arange(t, dtype=jnp.int32), true_len - 1)[None, :]
     logits, cache = model.forward(
         params, cfg, ids, cache=cache, positions=positions, kv_mask=kv_mask
@@ -133,7 +143,7 @@ def _install_program(state: SlotState, slot, c1: KVCache, true_len, first,
 
 def _step_program(params, state: SlotState, rng, *, cfg, sampling,
                   eos_id: int, pad_id: int, model,
-                  chunk: int = 1) -> Tuple[SlotState, jax.Array]:
+                  chunk: int = 1) -> Tuple[SlotState, jax.Array, jax.Array]:
     """`chunk` decode steps for all S slots (per-row cache offsets).
 
     Chunking exists because the paged loop is host-driven: every dispatch
@@ -142,7 +152,13 @@ def _step_program(params, state: SlotState, rng, *, cfg, sampling,
     program advancing `chunk` tokens amortizes that; the host reaps
     finished slots at chunk granularity (a slot finishing mid-chunk decodes
     pad tokens into its own — already dead — tail until the chunk ends).
-    Returns (state, tokens [chunk, S]).
+
+    Returns (state, tokens [chunk, S], active_snapshot [S] int8). The
+    snapshot duplicates state.active in a buffer that is NOT part of the
+    donated state tuple (int8, so it can never alias the donated bool
+    plane): the pipelined engine dispatches chunk N+1 — donating state N —
+    before reading chunk N's results, and reaping needs post-chunk-N
+    active flags that survive that donation.
     """
     tmax = state.cache.k.shape[3]
 
@@ -176,7 +192,7 @@ def _step_program(params, state: SlotState, rng, *, cfg, sampling,
         )
 
     state, toks = jax.lax.scan(one, state, jax.random.split(rng, chunk))
-    return state, toks
+    return state, toks, state.active.astype(jnp.int8)
 
 
 class PagedEngine:
@@ -190,13 +206,20 @@ class PagedEngine:
     """
 
     def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None,
-                 slots: Optional[int] = None, chunk: int = 8):
+                 slots: Optional[int] = None, chunk: int = 16,
+                 inflight: int = 2):
         enable_compilation_cache()
         self.config = config
         # Tokens per dispatched step program — see _step_program. Mid-chunk
         # admissions wait at most chunk device steps (ms-scale); host
         # round-trips shrink by the same factor.
         self.chunk = max(1, chunk)
+        # Chunk programs kept in flight: at 2 the host dispatches chunk N+1
+        # before reading chunk N's tokens, so the ~100 ms host<->device
+        # round trip overlaps the next chunk's compute instead of
+        # serializing every dispatch (round-4's paged engine gave up ~40%
+        # throughput to exactly this). 1 = the old dispatch-sync-reap loop.
+        self.inflight_limit = max(1, inflight)
         self.family, self.cfg = registry.resolve(
             config.model, config.dtype, config.param_dtype
         )
@@ -265,6 +288,12 @@ class PagedEngine:
         self.state = self._init_state()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: List[_Request] = []
+        # Dispatched-but-unread chunk programs, oldest first:
+        # (tokens [chunk, S] device array, active [S] int8 device array,
+        #  slot->request snapshot at dispatch time).
+        self._inflight: List[
+            Tuple[jax.Array, jax.Array, List[Optional[_Request]]]
+        ] = []
         self._next_rid = 0
         self.last_ttft_s: Optional[float] = None
         # Per-request time-to-first-token (submit() -> first token on host),
@@ -299,8 +328,27 @@ class PagedEngine:
         return req.rid
 
     def warmup(self) -> float:
-        """Compile the prefill/install/step programs; returns seconds."""
+        """Compile the step program and EVERY prompt-bucket prefill AND
+        install program (both retrace per prompt width — a width first
+        seen mid-serving would pay its XLA compile on a live request);
+        returns seconds."""
         t0 = time.monotonic()
+        buckets = sorted(
+            {min(b, self.bucket) for b in self.config.length_buckets}
+        )
+        for width in buckets:
+            ids = np.full((1, width), self.tokenizer.pad_id, np.int32)
+            self._rng, rng = jax.random.split(self._rng)
+            with self.mesh:
+                c1, first, seen_row = self._prefill(
+                    self.params, jnp.asarray(ids),
+                    jnp.asarray(1, jnp.int32), rng,
+                )
+                self.state = self._install(
+                    self.state, jnp.asarray(0, jnp.int32), c1,
+                    jnp.asarray(1, jnp.int32), first, seen_row,
+                )
+        self.reset()  # drop the ghost installs; compiled programs stay cached
         rid = self.submit("warmup")
         self.drain()
         self.ttfts.pop(rid, None)
@@ -308,7 +356,11 @@ class PagedEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._pending) or any(r is not None for r in self._slot_req)
+        return (
+            bool(self._pending)
+            or bool(self._inflight)
+            or any(r is not None for r in self._slot_req)
+        )
 
     def pop_ttfts(self) -> Dict[int, float]:
         """Drain the per-request TTFT measurements recorded since last call."""
@@ -326,6 +378,7 @@ class PagedEngine:
         self.state = self._init_state()
         self._slot_req = [None] * self.slots
         self._pending = []
+        self._inflight = []
         self.ttfts = {}
 
     def _admit(self) -> None:
@@ -338,7 +391,14 @@ class PagedEngine:
             if self._slot_req[slot] is not None or not self._pending:
                 continue
             req = self._pending.pop(0)
-            ids = np.full((1, self.bucket), self.tokenizer.pad_id, np.int32)
+            # Smallest length bucket that fits: a 10-token query prefills a
+            # 16/32-wide program, not the full Tmax-wide one (one compiled
+            # prefill per bucket; the decode cache stays Tmax).
+            bucket = min(
+                pick_bucket(req.prompt_len, self.config.length_buckets),
+                self.bucket,
+            )
+            ids = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
             ids[0, : req.prompt_len] = req.tokens
             self._rng, rng = jax.random.split(self._rng)
             with self.mesh:
@@ -362,23 +422,65 @@ class PagedEngine:
             self.ttfts[req.rid] = ttft
             self.last_ttft_s = ttft
 
+    def _live(self) -> bool:
+        return any(r is not None and not r.finished for r in self._slot_req)
+
     def step(self) -> List[Tuple[int, str]]:
-        """Admit pending requests, advance one `chunk`-token step program,
-        reap finished slots."""
+        """Admit pending requests, dispatch the next `chunk`-token program,
+        reap the oldest in-flight chunk once the pipeline is full.
+
+        Pipelining (inflight_limit=2 default): the dispatch for chunk N+1
+        goes out BEFORE chunk N's tokens are read back, so the host's
+        ~100 ms readback round trip overlaps chunk N+1's device compute —
+        round-4's serialized loop left the device idle for every readback
+        and gave up ~40% throughput to it. Completions therefore surface
+        one step() call after their chunk at steady state; the tail drains
+        in the same call once no live slot remains.
+        """
         self._admit()
+        if self._live():
+            self._rng, rng = jax.random.split(self._rng)
+            with self.mesh:
+                self.state, toks, active = self._step(
+                    self.params, self.state, rng
+                )
+            # No blocking readback here — but START the device->host copies
+            # now, so the chunk's results stream back while later chunks
+            # compute. On the high-latency bench link this is the entire
+            # ballgame: reap-time device_get paid a ~200 ms round trip per
+            # chunk (measured), serializing the loop at ~270 tok/s; with
+            # the copies in flight the same loop measures ~930 tok/s at
+            # chunk=8 and ~1.9k at chunk=32.
+            for arr in (toks, active):
+                try:
+                    arr.copy_to_host_async()
+                except (AttributeError, NotImplementedError):
+                    pass  # backend without async copies: reap still works
+            # The slot snapshot records which request each column belonged
+            # to at dispatch time (a slot reused later belongs to a later
+            # chunk).
+            self._inflight.append((toks, active, list(self._slot_req)))
         done: List[Tuple[int, str]] = []
-        if not any(r is not None for r in self._slot_req):
-            return done
-        self._rng, rng = jax.random.split(self._rng)
-        with self.mesh:
-            self.state, toks = self._step(self.params, self.state, rng)
-            # One sync per chunk; active rides along so slot death is read
-            # from the program, not inferred from token values.
-            toks = np.asarray(toks)  # [chunk, S]
-            active = np.asarray(self.state.active)
+        while self._inflight and (
+            len(self._inflight) >= self.inflight_limit
+            if self._live()
+            else True
+        ):
+            done.extend(self._reap(*self._inflight.pop(0)))
+            # _reap may finish the last live request: the loop condition
+            # re-evaluates _live(), so remaining chunks drain right here.
+        return done
+
+    def _reap(self, toks_dev, active_dev, slot_snapshot) -> List[Tuple[int, str]]:
+        """Read one chunk's results and finish the requests it completed."""
+        toks = np.asarray(toks_dev)      # [chunk, S] — the sync point
+        active = np.asarray(active_dev)  # [S] int8 post-chunk active flags
+        done: List[Tuple[int, str]] = []
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
-        for slot, req in enumerate(self._slot_req):
-            if req is None:
+        for slot, req in enumerate(slot_snapshot):
+            if req is None or req.finished:
+                # Empty at dispatch, or finished by an earlier chunk — this
+                # chunk's column holds dead-slot filler.
                 continue
             finished = False
             dead = not bool(active[slot])
@@ -414,11 +516,16 @@ class PagedEngine:
             if dead:
                 finished = True
             if finished:
+                req.finished = True
                 text = self.tokenizer.decode(
                     [t for t in req.tokens if t != eos]
                 )
                 done.append((req.rid, text))
-                self._slot_req[slot] = None
+                if self._slot_req[slot] is req:
+                    self._slot_req[slot] = None
+                # Kill the slot in the LIVE state (which may already be a
+                # chunk ahead): load-bearing for the host-side max_new/tmax
+                # caps, where the device still thinks the slot is active.
                 self.state = SlotState(
                     cache=self.state.cache,
                     tok=self.state.tok,

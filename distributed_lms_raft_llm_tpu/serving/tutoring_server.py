@@ -185,6 +185,9 @@ def main(argv=None) -> None:
     parser.add_argument("--slots", type=int, default=None,
                         help="paged engine decode slots (default: max batch "
                         "bucket)")
+    parser.add_argument("--chunk", type=int, default=16,
+                        help="paged engine tokens per dispatched step "
+                        "program; admission joins at chunk boundaries")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
                              "ephemeral); omit to disable")
@@ -209,7 +212,8 @@ def main(argv=None) -> None:
             "vocab": t.vocab, "merges": t.merges, "tp": t.tp,
             "quant": t.quant, "max_new_tokens": s.max_new_tokens,
             "max_batch": t.max_batch, "max_wait_ms": t.max_wait_ms,
-            "slots": t.slots, "auth_key_file": t.auth_key_file,
+            "slots": t.slots, "chunk": t.chunk,
+            "auth_key_file": t.auth_key_file,
             # store_true flags merge the same way: presence in argv is what
             # marks them explicit, so the file fills only absent ones.
             "kv_quant": t.kv_quant, "paged": t.paged,
@@ -255,7 +259,8 @@ def main(argv=None) -> None:
     if args.paged:
         # --max-batch bounds concurrency in both modes: it is the decode
         # slot count here (unless --slots overrides it explicitly).
-        engine = PagedEngine(config, slots=args.slots or args.max_batch)
+        engine = PagedEngine(config, slots=args.slots or args.max_batch,
+                             chunk=args.chunk)
     else:
         engine = TutoringEngine(config)
     if not args.no_warmup:

@@ -48,20 +48,21 @@ async def run(args) -> dict:
     from distributed_lms_raft_llm_tpu.serving import tutoring_server
     from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
 
-    artifacts = ensure_local_artifacts()
+    # The local trained checkpoint is gpt2-small; larger models bench
+    # random-init at full size (decode cost is weight-value-independent —
+    # same caveat as bench.py / BASELINE config 3).
+    artifacts = ensure_local_artifacts() if args.model == "gpt2" else {}
     config = EngineConfig(
-        model="gpt2",
-        checkpoint=artifacts["checkpoint"],
-        vocab_path=artifacts["vocab_path"],
-        merges_path=artifacts["merges_path"],
+        model=args.model,
         sampling=SamplingParams.reference_defaults(
             max_new_tokens=args.max_new_tokens
         ),
         quant=args.quant,
         kv_quant=args.kv_quant,
+        **artifacts,
     )
     if args.paged:
-        engine = PagedEngine(config, slots=8)
+        engine = PagedEngine(config, slots=args.slots, chunk=args.chunk)
     else:
         engine = TutoringEngine(config)
     engine.warmup()
@@ -116,6 +117,7 @@ async def run(args) -> dict:
         "metric": "tutoring_server_ttft_p50_ms_under_concurrency",
         "value": round(ttft.get("p50_s", 0.0) * 1000, 2),
         "unit": "ms",
+        "model": args.model,
         "clients": args.clients,
         "queries_per_client": args.queries,
         "engine": "paged" if args.paged else "batched",
@@ -132,10 +134,15 @@ async def run(args) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="gpt2",
+                    help="any models/registry preset (BASELINE config 3 = "
+                         "gpt2-medium)")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--queries", type=int, default=4)
     ap.add_argument("--max-new-tokens", type=int, default=128)
     ap.add_argument("--paged", action="store_true")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
     ap.add_argument("--quant", default=None, choices=["int8"])
     ap.add_argument("--kv-quant", action="store_true")
     args = ap.parse_args()
