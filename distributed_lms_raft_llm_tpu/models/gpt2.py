@@ -61,6 +61,14 @@ class GPT2Config:
     # HBM bytes every decode step streams for attention. Set by the engine
     # (EngineConfig.kv_quant); mutually exclusive with the pallas kernel.
     quant_kv: bool = False
+    # Long-context sequence parallelism: a jax.sharding.Mesh with an `sp`
+    # axis of size > 1 routes FULL-SEQUENCE attention (cache is None — the
+    # training / long-context scoring direction) through
+    # parallel.ring.ring_attention, with q/k/v sequence-sharded over `sp`
+    # and K/V blocks rotating on ppermute. Exact (online-softmax) causal
+    # attention; decode stays on the tp/dp cache path (ring.py scope note).
+    # Mesh is hashable, so cfg stays a valid jit static argument.
+    ring_mesh: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -136,6 +144,88 @@ def init_cache(cfg: GPT2Config, batch: int, max_len: int, dtype=None) -> KVCache
     )
 
 
+def apply_block(x, lp, attend_fn, cfg: GPT2Config):
+    """One transformer block; `attend_fn(q, k_new, v_new) -> context` owns
+    cache handling + attention so every path (dense, ring, cached decode,
+    pipeline stage) shares one copy of the math."""
+    eps = cfg.layer_norm_eps
+    h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], eps)
+    qkv = dense(h, lp["attn"]["wqkv"], lp["attn"]["bqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    a = attend_fn(
+        split_heads(q, cfg.num_heads),
+        split_heads(k, cfg.num_heads),
+        split_heads(v, cfg.num_heads),
+    )
+    x = x + dense(merge_heads(a), lp["attn"]["wo"], lp["attn"]["bo"])
+    h2 = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], eps)
+    m = dense(h2, lp["mlp"]["wi"], lp["mlp"]["bi"])
+    m = jax.nn.gelu(m, approximate=True)  # GPT-2 uses the tanh approximation
+    x = x + dense(m, lp["mlp"]["wo"], lp["mlp"]["bo"])
+    return x
+
+
+def trunk_layer(lp, h, *, cfg: GPT2Config):
+    """One block in full-sequence causal mode: the `layer_fn(lp, h) -> h`
+    shape `parallel.pipeline.pipeline_trunk` consumes. The causal mask is
+    rebuilt from h's shape so the function closes over nothing traced
+    (shard_map stage bodies take all operands as arguments)."""
+    t = h.shape[1]
+    pos = jnp.arange(t)
+    mask = (pos[None, :] <= pos[:, None])[None, None]
+    return apply_block(h, lp, lambda q, k, v: attend(q, k, v, mask), cfg)
+
+
+def forward_pipelined(
+    params: Params,
+    cfg: GPT2Config,
+    input_ids: jax.Array,
+    mesh,
+    *,
+    n_micro: int,
+    batch_spec=None,
+    remat: bool = False,
+) -> jax.Array:
+    """Full-sequence forward with the stacked trunk sharded over the mesh's
+    `pp` axis (parallel.pipeline.pipeline_trunk, GPipe microbatching).
+
+    Embedding, final layer norm, and the tied unembedding run under jit's
+    ordinary sharding; the L blocks run as pp pipeline stages, each device
+    holding L/pp layers. Returns logits identical (up to float error) to
+    `forward(params, cfg, input_ids)[0]` — parity-tested. `batch_spec`
+    forwards to pipeline_trunk for dp composition of the microbatched
+    activations.
+    """
+    from ..parallel.pipeline import pipeline_trunk
+
+    if mesh.shape.get("tp", 1) > 1:
+        raise ValueError(
+            "forward_pipelined does not compose with tp (the pipeline "
+            "stage body has no tensor-parallel collectives); use pp x dp"
+        )
+    _, t = input_ids.shape
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    x = quant.embed_lookup(params["wte"], input_ids) + params["wpe"][positions]
+    x = x.astype(cfg.dtype)
+    layer_fn = lambda lp, h: trunk_layer(lp, h, cfg=cfg)  # noqa: E731
+    if remat:
+        # Recompute each stage layer's activations in the backward pass —
+        # the pipeline holds every microbatch's activations live through
+        # its fori_loop, so remat matters MORE here than in the scan trunk.
+        layer_fn = jax.checkpoint(layer_fn)
+    x = pipeline_trunk(
+        layer_fn,
+        params["blocks"],
+        x,
+        mesh,
+        n_micro=n_micro,
+        batch_spec=batch_spec,
+    )
+    x = layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"],
+                   cfg.layer_norm_eps)
+    return quant.unembed(x, params["wte"])
+
+
 def forward(
     params: Params,
     cfg: GPT2Config,
@@ -164,6 +254,7 @@ def forward(
     b, t = input_ids.shape
     eps = cfg.layer_norm_eps
     num_heads = cfg.num_heads
+    default_positions = positions is None
 
     offset = jnp.zeros((), jnp.int32) if cache is None else cache.length
     if offset.ndim == 1 and t != 1:
@@ -183,31 +274,32 @@ def forward(
         mask = mask & kv_mask[:, None, None, :]
 
     def block(x, layer_params, attend_fn):
-        """One transformer block; `attend_fn(q, k_new, v_new) -> context`
-        owns cache handling + attention so both paths share one copy of
-        the math.
-        """
-        lp = layer_params
-        h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], eps)
-        qkv = dense(h, lp["attn"]["wqkv"], lp["attn"]["bqkv"])
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        a = attend_fn(
-            split_heads(q, num_heads),
-            split_heads(k, num_heads),
-            split_heads(v, num_heads),
-        )
-        x = x + dense(merge_heads(a), lp["attn"]["wo"], lp["attn"]["bo"])
-        h2 = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], eps)
-        m = dense(h2, lp["mlp"]["wi"], lp["mlp"]["bi"])
-        m = jax.nn.gelu(m, approximate=True)  # GPT-2 uses the tanh approximation
-        x = x + dense(m, lp["mlp"]["wo"], lp["mlp"]["bo"])
-        return x
+        return apply_block(x, layer_params, attend_fn, cfg)
 
     if cache is None:
+        ring = (
+            cfg.ring_mesh is not None
+            and cfg.ring_mesh.shape.get("sp", 1) > 1
+        )
+        if ring:
+            # Ring attention computes exact CAUSAL attention from absolute
+            # block offsets; padding masks / custom position tables are the
+            # cache path's business.
+            if kv_mask is not None or not default_positions:
+                raise ValueError(
+                    "ring attention (cfg.ring_mesh) supports full causal "
+                    "sequences only: no kv_mask, default positions"
+                )
+            from ..parallel.ring import ring_attention
+
+            attend_full = lambda q, k, v: ring_attention(  # noqa: E731
+                q, k, v, cfg.ring_mesh
+            )
+        else:
+            attend_full = lambda q, k, v: attend(q, k, v, mask)  # noqa: E731
+
         def body(carry, lp):
-            return block(
-                carry, lp, lambda q, k, v: attend(q, k, v, mask)
-            ), None
+            return block(carry, lp, attend_full), None
 
         x, _ = jax.lax.scan(body, x, params["blocks"])
         new_cache = None

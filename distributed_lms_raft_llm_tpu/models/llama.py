@@ -64,6 +64,9 @@ class LlamaConfig:
     # int8 KV cache with per-slot scales (common.quantize_kv); same
     # contract as GPT2Config.quant_kv.
     quant_kv: bool = False
+    # Mesh with an `sp` axis > 1: full-sequence attention runs as ring
+    # attention, sequence-sharded (same contract as GPT2Config.ring_mesh).
+    ring_mesh: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -158,6 +161,7 @@ def forward(
     eps = cfg.rms_norm_eps
     nh, nkv = cfg.num_heads, cfg.num_kv_heads
     groups = nh // nkv
+    default_positions = positions is None
 
     offset = jnp.zeros((), jnp.int32) if cache is None else cache.length
     if offset.ndim == 1 and t != 1:
@@ -199,8 +203,34 @@ def forward(
         )
 
     if cache is None:
+        ring = (
+            cfg.ring_mesh is not None
+            and cfg.ring_mesh.shape.get("sp", 1) > 1
+        )
+        if ring:
+            if kv_mask is not None or not default_positions:
+                raise ValueError(
+                    "ring attention (cfg.ring_mesh) supports full causal "
+                    "sequences only: no kv_mask, default positions"
+                )
+            from ..parallel.ring import ring_attention
+
+            def attend_ring(q, k_att, v_att):
+                # GQA: broadcast the shared KV heads before the ring so
+                # every block rotation carries [B, H, T/sp, Dh].
+                return ring_attention(
+                    q,
+                    repeat_kv(k_att.astype(q.dtype), groups),
+                    repeat_kv(v_att.astype(q.dtype), groups),
+                    cfg.ring_mesh,
+                )
+
+            attend_full = attend_ring
+        else:
+            attend_full = full_attend
+
         def body(carry, lp):
-            return block(carry, lp, full_attend), None
+            return block(carry, lp, attend_full), None
 
         x, _ = jax.lax.scan(body, x, params["blocks"])
         new_cache = None

@@ -16,6 +16,13 @@ inference, reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:10-31);
 SURVEY §2.2 lists PP as the optional later axis, and this makes `pp` in
 `parallel.mesh` a real capability like `sp` (ring attention) rather than a
 decorative mesh dimension.
+
+Production reachability: `gpt2.forward_pipelined` runs the real GPT-2
+trunk through this schedule, and `train.make_sharded_train_step` uses it
+for any mesh with pp > 1 (the train CLI's --pp/--pp-micro flags), with the
+stacked layer weights and their optimizer moments stage-sharded
+(train_state_shardings). Loss parity vs the sequential trunk is pinned in
+tests/test_model_parallel.py.
 """
 
 from __future__ import annotations
@@ -99,6 +106,7 @@ def pipeline_trunk(
     n_micro: int,
     axis_name: str = "pp",
     param_spec: P = None,
+    batch_spec: P = None,
 ) -> jax.Array:
     """Apply L stacked layers to x [B, ...] with the layer axis sharded over
     `axis_name` and the batch split into `n_micro` microbatches.
@@ -107,6 +115,11 @@ def pipeline_trunk(
     block); `stacked_params` is any pytree whose leaves lead with the layer
     axis L (L divisible by the pp size, B divisible by n_micro). Returns
     exactly `lax.scan(layer_fn, x, stacked_params)`'s result.
+
+    `batch_spec` is the spec of the microbatched activations
+    [n_micro, B/n_micro, ...] — pass e.g. P(None, "dp") to keep the batch
+    data-parallel inside the stages (the pp psum at the end leaves other
+    axes untouched); default fully replicated.
     """
     n_stages = mesh.shape[axis_name]
     b = x.shape[0]
@@ -119,6 +132,7 @@ def pipeline_trunk(
             f"axis size {n_stages}"
         )
     param_spec = param_spec or P(axis_name)
+    batch_spec = batch_spec or P()
     xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
 
     specs_params = jax.tree.map(lambda _: param_spec, stacked_params)
@@ -128,8 +142,8 @@ def pipeline_trunk(
             n_micro=n_micro, axis_name=axis_name,
         ),
         mesh=mesh,
-        in_specs=(specs_params, P()),
-        out_specs=P(),
+        in_specs=(specs_params, batch_spec),
+        out_specs=batch_spec,
     )
     out = fn(stacked_params, xm)
     return out.reshape(x.shape)

@@ -20,6 +20,12 @@ decorative axis.
 
 Scope: the prefill/training direction (full-sequence attention). Decode
 reads a KV cache one token at a time and stays on the tp/dp path.
+
+Production reachability: `GPT2Config.ring_mesh` / `LlamaConfig.ring_mesh`
+route the models' full-sequence attention here (models/gpt2.py,
+models/llama.py), and `train.make_sharded_train_step` activates it for any
+mesh with sp > 1 (the train CLI's --sp flag), sharding the batch's
+sequence dim over sp. Parity pinned in tests/test_model_parallel.py.
 """
 
 from __future__ import annotations

@@ -33,6 +33,10 @@ class TrainConfig:
     decay_steps: int = 10_000  # cosine horizon; set to the planned run length
     max_grad_norm: float = 1.0
     remat: bool = True  # rematerialize block activations (HBM for FLOPs)
+    # GPipe microbatches per step when the mesh has a pp axis > 1 (the
+    # stacked trunk pipelines via parallel.pipeline.pipeline_trunk; bubble
+    # fraction (pp-1)/(pp_micro+pp-1)).
+    pp_micro: int = 2
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
@@ -72,11 +76,19 @@ def init_train_state(
 def train_state_shardings(state, mesh: Mesh):
     """NamedShardings for the whole train state: params + optimizer moments
     follow the model partition rules (adam mu/nu mirror param shapes);
-    scalars replicate."""
+    scalars replicate. A pp axis > 1 additionally shards every stacked
+    block leaf's leading layer axis over pp — each pipeline stage stores
+    only its own L/pp layers (and their optimizer moments)."""
 
     param_specs = partition.match_partition_rules(
         partition.GPT2_RULES, state["params"]
     )
+    if mesh.shape.get("pp", 1) > 1:
+        param_specs["blocks"] = jax.tree.map(
+            lambda s: P("pp", *tuple(s)[1:]),
+            param_specs["blocks"],
+            is_leaf=lambda s: isinstance(s, P),
+        )
 
     # Optimizer leaves that mirror a parameter (same shape) reuse its spec;
     # everything else (counts, scalars) replicates.
@@ -109,15 +121,54 @@ def make_train_step(
     model_cfg: gpt2.GPT2Config,
     optimizer,
     remat: bool = True,
+    mesh: Optional[Mesh] = None,
+    pp_micro: int = 2,
 ) -> Callable:
     """Returns train_step(state, batch) -> (state, metrics); jit it with the
-    shardings from `train_state_shardings` + batch over dp."""
+    shardings from `train_state_shardings` + batch over dp.
 
-    forward = gpt2.forward
-    if remat:
-        forward = jax.checkpoint(
-            partial(gpt2.forward), static_argnums=(1,)
-        )
+    Parallel axes beyond dp/tp activate from the mesh shape:
+    - sp > 1: the model's full-sequence attention runs as ring attention
+      (gpt2.GPT2Config.ring_mesh), the batch's sequence dim sharded over sp;
+    - pp > 1: the stacked trunk runs as a GPipe pipeline
+      (gpt2.forward_pipelined) with `pp_micro` microbatches, layer weights
+      stage-sharded per `train_state_shardings`.
+    """
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        model_cfg = dataclasses.replace(model_cfg, ring_mesh=mesh)
+    pipelined = mesh is not None and mesh.shape.get("pp", 1) > 1
+
+    if pipelined:
+        # Combinations the pipeline schedule does not implement yet — fail
+        # loudly rather than silently degrade:
+        # - sp: trunk_layer uses dense full-sequence attention, so ring
+        #   attention (the whole point of --sp) would be dropped;
+        # - tp: the shard_map stage body has no tp collectives, so sharded
+        #   weight in_specs would compute wrong partials (and replicated
+        #   ones would all-gather tp-sharded weights every step).
+        if mesh.shape.get("sp", 1) > 1:
+            raise ValueError(
+                "pp and sp cannot combine: the pipeline stage body uses "
+                "dense attention (ring attention unreachable under pp)"
+            )
+        if mesh.shape.get("tp", 1) > 1:
+            raise ValueError(
+                "pp and tp cannot combine: the pipeline stage body has no "
+                "tensor-parallel collectives; use pp x dp"
+            )
+
+        def forward(params, _cfg, input_ids):
+            logits = gpt2.forward_pipelined(
+                params, model_cfg, input_ids, mesh, n_micro=pp_micro,
+                batch_spec=P(None, "dp"), remat=remat,
+            )
+            return logits, None
+    else:
+        forward = gpt2.forward
+        if remat:
+            forward = jax.checkpoint(
+                partial(gpt2.forward), static_argnums=(1,)
+            )
 
     def loss_fn(params, input_ids, loss_mask):
         logits, _ = forward(params, model_cfg, input_ids)
@@ -160,12 +211,15 @@ def make_sharded_train_step(
     state = jax.tree.map(
         lambda x, s: jax.device_put(x, s), state, state_shardings
     )
+    # sp > 1: the sequence dim shards too (ring attention consumes it).
+    seq_axis = "sp" if mesh.shape.get("sp", 1) > 1 else None
     batch_sharding = {
-        "input_ids": NamedSharding(mesh, P("dp", None)),
-        "loss_mask": NamedSharding(mesh, P("dp", None)),
+        "input_ids": NamedSharding(mesh, P("dp", seq_axis)),
+        "loss_mask": NamedSharding(mesh, P("dp", seq_axis)),
     }
     step = jax.jit(
-        make_train_step(model_cfg, optimizer, remat=train_cfg.remat),
+        make_train_step(model_cfg, optimizer, remat=train_cfg.remat,
+                        mesh=mesh, pp_micro=train_cfg.pp_micro),
         in_shardings=(state_shardings, batch_sharding),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
@@ -275,6 +329,15 @@ def main(argv=None) -> None:
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--sp", type=int, default=1,
+                        help="sequence-parallel ways: full-sequence "
+                        "attention runs as ring attention over sp shards "
+                        "(long-context training)")
+    parser.add_argument("--pp", type=int, default=1,
+                        help="pipeline stages: the stacked trunk shards "
+                        "L/pp layers per device (GPipe microbatching)")
+    parser.add_argument("--pp-micro", type=int, default=2,
+                        help="microbatches per step when --pp > 1")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -285,12 +348,15 @@ def main(argv=None) -> None:
         args.data, tokenizer,
         DataConfig(batch_size=args.batch_size, seq_len=args.seq_len),
     )
-    mesh = mesh_lib.make_mesh({"tp": args.tp, "dp": -1})
+    mesh = mesh_lib.make_mesh(
+        {"pp": args.pp, "sp": args.sp, "tp": args.tp, "dp": -1}
+    )
     steps = args.epochs * dataset.steps_per_epoch()
     train_cfg = TrainConfig(
         learning_rate=args.lr,
         warmup_steps=max(1, steps // 20),
         decay_steps=max(2, steps),
+        pp_micro=args.pp_micro,
     )
     result = fit(
         mesh, model_cfg, train_cfg, dataset, epochs=args.epochs,

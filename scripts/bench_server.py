@@ -48,10 +48,19 @@ async def run(args) -> dict:
     from distributed_lms_raft_llm_tpu.serving import tutoring_server
     from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
 
-    # The local trained checkpoint is gpt2-small; larger models bench
-    # random-init at full size (decode cost is weight-value-independent —
-    # same caveat as bench.py / BASELINE config 3).
-    artifacts = ensure_local_artifacts() if args.model == "gpt2" else {}
+    # The local trained checkpoint is gpt2-small; larger gpt2-* models
+    # bench random-init at full size (decode cost is weight-value-
+    # independent — same caveat as bench.py / BASELINE config 3) but KEEP
+    # the BPE vocab/merges: tokenization is model-size-independent, and
+    # the byte fallback would tokenize ~4x longer prompts, skewing
+    # cross-size TTFT comparisons.
+    artifacts = {}
+    if args.model.startswith("gpt2"):
+        art = ensure_local_artifacts()
+        artifacts = {"vocab_path": art["vocab_path"],
+                     "merges_path": art["merges_path"]}
+        if args.model == "gpt2":
+            artifacts["checkpoint"] = art["checkpoint"]
     config = EngineConfig(
         model=args.model,
         sampling=SamplingParams.reference_defaults(
