@@ -68,6 +68,17 @@ class RelevanceGate:
             params = quant_lib.quantize_params(params, "bert")
         self.params = partition.shard_tree(params, self.mesh, partition.BERT_RULES)
         self._embed = jax.jit(partial(bert.embed, cfg=self.cfg))
+        # Context (assignment text) embeddings are static per student and
+        # re-checked on every query; caching them halves the per-query gate
+        # compute — the reference re-loads the whole MODEL per request
+        # (lms_server.py:1258-1260), this caches the embedding too. The
+        # lock guards the miss path: check() runs on the server's executor
+        # threads, and an unlocked len/clear/insert race would evict
+        # entries concurrent misses just computed.
+        import threading
+
+        self._ctx_cache: dict = {}
+        self._ctx_lock = threading.Lock()
 
     def _encode(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
         limit = self.cfg.max_position_embeddings
@@ -94,11 +105,29 @@ class RelevanceGate:
         return np.asarray(jax.device_get(out))
 
     def check(self, query: str, context: str) -> Tuple[bool, float]:
-        """(passes_gate, cosine_similarity) — reference threshold 0.6."""
-        emb = self.embed_texts([query, context])
+        """(passes_gate, cosine_similarity) — reference threshold 0.6.
+
+        The context embedding is cached by text (bounded; cleared wholesale
+        at 256 entries), so a student's Nth query embeds only the query. A
+        miss embeds [query, context] in ONE batched call — the same single
+        dispatch the uncached path always cost — and caches the context
+        half. Mask-weighted mean pooling makes the embedding independent of
+        the padding bucket, so cached (context-alone) and joint embeddings
+        agree (pinned in tests/test_quant.py).
+        """
+        ctx_emb = self._ctx_cache.get(context)
+        if ctx_emb is None:
+            emb = self.embed_texts([query, context])
+            q_emb, ctx_emb = emb[0], emb[1]
+            with self._ctx_lock:
+                if len(self._ctx_cache) >= 256:
+                    self._ctx_cache.clear()
+                self._ctx_cache[context] = ctx_emb
+        else:
+            q_emb = self.embed_texts([query])[0]
         sim = float(
-            np.dot(emb[0], emb[1])
-            / max(float(np.linalg.norm(emb[0]) * np.linalg.norm(emb[1])), 1e-12)
+            np.dot(q_emb, ctx_emb)
+            / max(float(np.linalg.norm(q_emb) * np.linalg.norm(ctx_emb)), 1e-12)
         )
         return sim >= self.config.threshold, sim
 

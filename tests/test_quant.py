@@ -286,3 +286,28 @@ def test_bert_gate_quantized_similarity_close():
         _, sim_full = full.check(a, b)
         _, sim_q = q.check(a, b)
         assert abs(float(sim_full) - float(sim_q)) < 0.05, (a, b)
+
+
+def test_gate_context_cache_matches_joint_embedding():
+    """The cached path (query embedded alone, cached context from the
+    joint batch) must reproduce the joint-batch cosine: mask-weighted mean
+    pooling makes embeddings bucket-independent, including when the short
+    query would alone pick a narrower bucket than the long context."""
+    from distributed_lms_raft_llm_tpu.engine.gate import (
+        GateConfig, RelevanceGate,
+    )
+
+    gate = RelevanceGate(GateConfig(model="tiny", dtype=jnp.float32))
+    q = "short query"
+    ctx = "a much longer assignment context " * 12  # forces a wider bucket
+    # Oracle: the pre-cache behavior — one joint [q, ctx] embed.
+    emb = gate.embed_texts([q, ctx])
+    sim_joint = float(
+        np.dot(emb[0], emb[1])
+        / (np.linalg.norm(emb[0]) * np.linalg.norm(emb[1]))
+    )
+    _, sim_first = gate.check(q, ctx)       # miss: joint embed + cache
+    assert ctx in gate._ctx_cache
+    _, sim_cached = gate.check(q, ctx)      # hit: query embedded ALONE
+    assert sim_first == pytest.approx(sim_joint, abs=1e-5)
+    assert sim_cached == pytest.approx(sim_joint, abs=1e-5)
