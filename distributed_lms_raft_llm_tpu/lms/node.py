@@ -59,7 +59,9 @@ class LMSNode:
         transport = transport or GrpcTransport(self.addresses)
         self.node = RaftNode(
             node_id,
-            list(self.addresses),
+            # id -> address mapping seeds raft membership; a durable
+            # membership from a previous run's config changes overrides it.
+            dict(self.addresses),
             storage,
             transport,
             apply_cb=self._apply,
@@ -67,6 +69,10 @@ class LMSNode:
             config=raft_config,
             last_applied=applied,
         )
+        # Keep the file-replication peer list in sync with raft membership
+        # (a server added at runtime receives blob anti-entropy too).
+        self.node.membership_cb = self._on_membership
+        self._on_membership(self.node.core.members)
         # Compact the WAL up to the restored snapshot and prime the
         # InstallSnapshot payload for lagging peers (a restart loses the
         # in-memory copy; the core keeps only (index, term) durably).
@@ -83,6 +89,14 @@ class LMSNode:
         self.snapshots.save(self.state, self._last_applied_index)
 
     # ------------------------------------------------------------ internals
+
+    def _on_membership(self, members) -> None:
+        for nid, address in members.items():
+            if address:
+                self.addresses[nid] = address
+        for nid in list(self.addresses):
+            if nid not in members:
+                self.addresses.pop(nid, None)
 
     def _snapshot_bytes(self) -> bytes:
         # NO sort_keys: the applied_requests idempotency ledger dedupes by

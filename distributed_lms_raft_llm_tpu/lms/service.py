@@ -72,7 +72,11 @@ class LMSServicer(rpc.LMSServicer):
         self._tutoring_channel: Optional[grpc.aio.Channel] = None
         self._tutoring_stub = None
         # Peer map for blob anti-entropy (fetch-on-miss); empty = disabled.
-        self._peer_addresses = dict(peer_addresses or {})
+        # Kept as a LIVE reference (no copy): the caller passes the same
+        # mapping runtime membership changes mutate (LMSNode.addresses), so
+        # the blob fetch-on-miss path sees servers added or removed after
+        # boot.
+        self._peer_addresses = peer_addresses if peer_addresses is not None else {}
         self._self_id = self_id
         # Negative cache: rel_path -> monotonic deadline before which peer
         # fetches are not retried. Without it, every read referencing a

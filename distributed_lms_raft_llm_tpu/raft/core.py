@@ -34,6 +34,11 @@ from .messages import (
     InstallSnapshotResponse,
     VoteRequest,
     VoteResponse,
+    decode_membership,
+    encode_membership,
+    is_membership,
+    unwrap_snapshot,
+    wrap_snapshot,
 )
 
 
@@ -79,7 +84,14 @@ class RaftCore:
         last_applied: int = 0,
     ):
         self.node_id = node_id
-        self.peer_ids = [p for p in peer_ids if p != node_id]
+        # peer_ids: a sequence of ids, or an id -> address mapping (the
+        # addresses then seed the membership map below).
+        if isinstance(peer_ids, dict):
+            boot_members = {int(k): v for k, v in peer_ids.items()}
+        else:
+            boot_members = {int(p): "" for p in peer_ids}
+        boot_members.setdefault(node_id, "")
+        self.peer_ids = [p for p in boot_members if p != node_id]
         self.storage = storage
         self.config = config or RaftConfig()
         self._rng = random.Random(node_id if seed is None else seed)
@@ -97,6 +109,7 @@ class RaftCore:
         # Volatile state.
         self.role = Role.FOLLOWER
         self.leader_id: Optional[int] = None
+        self._proposed_term = self.current_term  # see start_election
         # A state-machine snapshot may cover a prefix of the log; start
         # commit/applied there so replay resumes after it (lms.persistence
         # stores applied_index in its snapshot).
@@ -127,16 +140,36 @@ class RaftCore:
         # until commit_installed_snapshot — see on_install_snapshot.
         self.pending_snapshot: Optional[Tuple[int, bytes]] = None
         self._staged_install: Optional[InstallSnapshotRequest] = None
+        self._staged_members: Optional[Dict[int, str]] = None
+        self._staged_app_data: bytes = b""
         self.votes: Set[int] = set()
         self.next_index: Dict[int, int] = {}
         self.match_index: Dict[int, int] = {}
         self._last_heartbeat_sent = 0.0
+        # Last time a CURRENT leader contacted us (append/install with a
+        # valid term); drives the §4.2.3 vote-disruption guard below.
+        self._leader_contact = float("-inf")
         # peer -> time the last InstallSnapshot was dispatched (throttle).
         self._snapshot_sent_at: Dict[int, float] = {}
 
         # (peer_id, message) pairs for the runner to deliver.
         self.outbox: List[Tuple[int, object]] = []
         self.election_deadline = now + self._election_timeout()
+
+        # Cluster membership (Raft §4, one server at a time). `base_members`
+        # is the membership as of snapshot_index (persisted via
+        # storage.save_members when membership entries compact out of the
+        # log); the CURRENT membership is that base folded with every
+        # membership entry in the retained log — recomputed whenever the log
+        # gains/loses such entries. A durable base from a previous run wins
+        # over the constructor's boot topology.
+        stored = getattr(storage, "members", None)
+        self.base_members: Dict[int, str] = (
+            dict(stored) if stored is not None else boot_members
+        )
+        self.members: Dict[int, str] = {}
+        self.removed = False  # self no longer in membership: stop electing
+        self._refresh_membership()
 
     # ------------------------------------------------------------- helpers
 
@@ -172,6 +205,89 @@ class RaftCore:
     def _persist_meta(self) -> None:
         self.storage.save_meta(self.current_term, self.voted_for)
 
+    # ---------------------------------------------------------- membership
+
+    def _refresh_membership(self) -> None:
+        """Recompute current membership = base folded with the retained
+        log's membership entries. Called at boot and whenever the log
+        gains or loses membership entries (append, truncate, compact,
+        snapshot install) — truncation thereby ROLLS BACK an uncommitted
+        change, per the takes-effect-on-append rule."""
+        members = dict(self.base_members)
+        for e in self.log:
+            if is_membership(e.command):
+                members = decode_membership(e.command)
+        self.members = members
+        self.peer_ids = [p for p in members if p != self.node_id]
+        self.removed = self.node_id not in members
+        if self.role is Role.LEADER:
+            for p in self.peer_ids:
+                self.next_index.setdefault(p, self.last_log_index + 1)
+                self.match_index.setdefault(p, 0)
+            for p in list(self.next_index):
+                if p not in members:
+                    self.next_index.pop(p, None)
+                    self.match_index.pop(p, None)
+
+    def _fold_base_members(self, upto_log_prefix: int) -> None:
+        """Fold membership entries in log[:prefix] (about to be dropped by
+        compaction) into the durable base."""
+        changed = False
+        for e in self.log[:upto_log_prefix]:
+            if is_membership(e.command):
+                self.base_members = decode_membership(e.command)
+                changed = True
+        if changed and hasattr(self.storage, "save_members"):
+            self.storage.save_members(self.base_members)
+
+    def propose_config(
+        self, members: Dict[int, str], now: float
+    ) -> int:
+        """Leader-only: change membership by exactly one server (§4.1 —
+        consecutive one-server configs share a quorum, so no joint
+        consensus). The entry takes effect on this leader immediately;
+        a further change is rejected until this one commits."""
+        if self.role is not Role.LEADER:
+            raise NotLeader(self.leader_id)
+        # Safety precondition (Ongaro's 2015 single-server-change bug
+        # note): the leader must have COMMITTED an entry of its own term
+        # (the election no-op barrier) before appending a config change —
+        # otherwise a config entry committed under the new quorum can be
+        # overwritten by a resurrected older leader whose election quorum
+        # was judged under the old config.
+        if self.entry_term(self.commit_index) != self.current_term:
+            raise ConfigChangeInFlight(
+                self.commit_index,
+                "the leader has not yet committed an entry of its term "
+                "(election barrier in flight); retry shortly",
+            )
+        for i in range(
+            max(self.commit_index, self.snapshot_index) + 1,
+            self.last_log_index + 1,
+        ):
+            if is_membership(self.entry_at(i).command):
+                raise ConfigChangeInFlight(i)
+        members = {int(k): v for k, v in members.items()}
+        diff = set(members) ^ set(self.members)
+        if len(diff) != 1:
+            raise ValueError(
+                f"exactly one server may be added or removed per change "
+                f"(got {sorted(diff)})"
+            )
+        if self.node_id not in members:
+            raise ValueError(
+                "the leader cannot remove itself; remove a follower, or "
+                "stop this node and let the remainder elect first"
+            )
+        self.log.append(
+            Entry(term=self.current_term, command=encode_membership(members))
+        )
+        self.storage.append_entries(self.last_log_index, self.log[-1:])
+        self._refresh_membership()
+        self._advance_commit()
+        self.broadcast_append(now)
+        return self.last_log_index
+
     # ---------------------------------------------------------- transitions
 
     def tick(self, now: float) -> None:
@@ -181,25 +297,53 @@ class RaftCore:
             if now - self._last_heartbeat_sent >= self.config.heartbeat_interval:
                 self.broadcast_append(now)
         elif now >= self.election_deadline:
-            self.start_election(now)
+            if not self.removed:  # a removed server never disrupts the rest
+                self.start_election(now)
 
     def start_election(self, now: float) -> None:
+        """Campaign with a PROPOSED term = current + 1 that is adopted
+        (persisted, self-voted) only once a voter acknowledges it — the
+        wire-compatible equivalent of pre-vote on the frozen RequestVote
+        contract. A candidate whose requests are disregarded (the §4.2.3
+        lease guard below: a removed server, a node campaigning before its
+        AddServer lands, a partitioned node) therefore NEVER inflates its
+        own term, so when the leader later contacts it their terms match
+        and no step-down/re-election storm follows."""
         self.role = Role.CANDIDATE
-        self.current_term += 1
-        self.voted_for = self.node_id
-        self._persist_meta()
+        self._proposed_term = self.current_term + 1
         self.leader_id = None
         self.votes = {self.node_id}
         self._reset_election_timer(now)
         req = VoteRequest(
-            term=self.current_term,
+            term=self._proposed_term,
             candidate_id=self.node_id,
             last_log_index=self.last_log_index,
             last_log_term=self.last_log_term,
         )
         for peer in self.peer_ids:
             self.outbox.append((peer, req))
-        self._maybe_win(now)  # single-node cluster wins immediately
+        if not self.peer_ids:
+            # Single-node cluster: nobody to acknowledge; adopt and win.
+            if self._adopt_candidacy():
+                self._maybe_win(now)
+
+    def _adopt_candidacy(self) -> bool:
+        """Persist the proposed term + self-vote; False if this term is
+        already spoken for (we granted another candidate meanwhile)."""
+        proposed = getattr(self, "_proposed_term", self.current_term)
+        if self.current_term > proposed:
+            return False
+        if self.current_term == proposed:
+            if self.voted_for not in (None, self.node_id):
+                return False
+            if self.voted_for is None:
+                self.voted_for = self.node_id
+                self._persist_meta()
+            return True
+        self.current_term = proposed
+        self.voted_for = self.node_id
+        self._persist_meta()
+        return True
 
     def _step_down(self, term: int, now: float) -> None:
         if term > self.current_term:
@@ -213,6 +357,20 @@ class RaftCore:
     # Vote handling -------------------------------------------------------
 
     def on_vote_request(self, req: VoteRequest, now: float) -> VoteResponse:
+        # Disruption guard (Raft thesis §4.2.3): servers DISREGARD
+        # RequestVotes while they believe a current leader exists — a
+        # leader believes in itself, a follower within one minimum election
+        # timeout of leader contact believes in that leader. Without this,
+        # a REMOVED server (which never learns of its removal — the leader
+        # stops replicating to it) times out and deposes the live leader
+        # with ever-higher terms. Crucially the term is NOT adopted here;
+        # a genuinely deposed leader still steps down via the higher term
+        # on append/vote RESPONSES or a new leader's appends.
+        if (
+            self.role is Role.LEADER
+            or now - self._leader_contact < self.config.election_timeout_min
+        ):
+            return VoteResponse(term=self.current_term, granted=False)
         if req.term > self.current_term:
             self._step_down(req.term, now)
         granted = False
@@ -230,12 +388,18 @@ class RaftCore:
         return VoteResponse(term=self.current_term, granted=granted)
 
     def on_vote_response(self, peer: int, resp: VoteResponse, now: float) -> None:
-        if resp.term > self.current_term:
+        proposed = getattr(self, "_proposed_term", self.current_term)
+        if resp.term > max(self.current_term, proposed):
             self._step_down(resp.term, now)
             return
-        if self.role is not Role.CANDIDATE or resp.term != self.current_term:
+        if self.role is not Role.CANDIDATE:
             return
-        if resp.granted:
+        if resp.granted and resp.term == proposed:
+            # First acknowledgment adopts the proposed term (see
+            # start_election); a grant for a term we could not adopt —
+            # we voted for a competitor meanwhile — is discarded.
+            if not self._adopt_candidacy():
+                return
             self.votes.add(peer)
             self._maybe_win(now)
 
@@ -278,7 +442,12 @@ class RaftCore:
                     leader_id=self.node_id,
                     last_included_index=self.snapshot_index,
                     last_included_term=self.snapshot_term,
-                    data=self.snapshot_data,
+                    # base_members IS the membership at snapshot_index (all
+                    # config entries <= it are folded in); envelope it so
+                    # the receiver's config survives snapshot-covered
+                    # membership changes (thesis §7: snapshots carry the
+                    # latest configuration).
+                    data=wrap_snapshot(self.base_members, self.snapshot_data),
                 )
             # No snapshot bytes primed (shouldn't happen once the app calls
             # compact() at boot): send from the compaction boundary; the
@@ -314,6 +483,7 @@ class RaftCore:
         if self.role is not Role.FOLLOWER:
             self._step_down(req.term, now)
         self.leader_id = req.leader_id
+        self._leader_contact = now
         self._reset_election_timer(now)
 
         if req.prev_log_index > self.last_log_index:
@@ -352,16 +522,23 @@ class RaftCore:
         # Append / overwrite. Only truncate on a real mismatch (RPCs may be
         # stale or duplicated).
         index = req.prev_log_index
+        membership_dirty = False
         for i, entry in enumerate(req.entries):
             index = req.prev_log_index + 1 + i
             if index <= self.last_log_index:
                 if self.entry_term(index) != entry.term:
                     del self.log[index - self.snapshot_index - 1 :]
                     self.storage.truncate_from(index)
+                    # Truncation may drop an uncommitted membership entry.
+                    membership_dirty = True
                 else:
                     continue
             self.log.append(entry)
             self.storage.append_entries(index, [entry])
+            if is_membership(entry.command):
+                membership_dirty = True
+        if membership_dirty:
+            self._refresh_membership()
 
         if req.leader_commit > self.commit_index:
             self.commit_index = min(req.leader_commit, self.last_log_index)
@@ -446,6 +623,8 @@ class RaftCore:
                 self.snapshot_data = data  # re-prime after restart
             return
         term = self.entry_term(index)
+        # Membership entries leaving the log fold into the durable base.
+        self._fold_base_members(index - self.snapshot_index)
         del self.log[: index - self.snapshot_index]
         self.snapshot_index = index
         self.snapshot_term = term
@@ -462,6 +641,7 @@ class RaftCore:
         if self.role is not Role.FOLLOWER:
             self._step_down(req.term, now)
         self.leader_id = req.leader_id
+        self._leader_contact = now
         self._reset_election_timer(now)
 
         if req.last_included_index <= self.last_applied:
@@ -474,8 +654,11 @@ class RaftCore:
         # last_applied never advanced the leader's retry re-attempts the
         # install instead of being absorbed by the early-return above and
         # streaming entries past a hole the app never filled.
+        members, app_data = unwrap_snapshot(req.data)
         self._staged_install = req
-        self.pending_snapshot = (req.last_included_index, req.data)
+        self._staged_members = members
+        self._staged_app_data = app_data
+        self.pending_snapshot = (req.last_included_index, app_data)
         return InstallSnapshotResponse(term=self.current_term, success=True)
 
     def commit_installed_snapshot(self) -> None:
@@ -500,14 +683,27 @@ class RaftCore:
             del self.log[: req.last_included_index - self.snapshot_index]
         else:
             self.log = []
+        # The snapshot's enveloped membership (wrap_snapshot) IS the config
+        # at its boundary — adopting it covers membership entries the
+        # sender compacted away, and the retained suffix's entries refold
+        # on top in _refresh_membership. Legacy un-enveloped payloads keep
+        # the current folded view as an approximation.
+        self.base_members = (
+            dict(self._staged_members)
+            if self._staged_members is not None
+            else dict(self.members)
+        )
+        if hasattr(self.storage, "save_members"):
+            self.storage.save_members(self.base_members)
         self.snapshot_index = req.last_included_index
         self.snapshot_term = req.last_included_term
-        self.snapshot_data = req.data
+        self.snapshot_data = self._staged_app_data
         self.commit_index = max(self.commit_index, req.last_included_index)
         self.last_applied = req.last_included_index
         self.storage.install_snapshot(
             self.snapshot_index, self.snapshot_term, self.log
         )
+        self._refresh_membership()
 
     def abort_installed_snapshot(self) -> None:
         """Drop a staged snapshot whose application install failed."""
@@ -544,3 +740,13 @@ class NotLeader(Exception):
     def __init__(self, leader_id: Optional[int]):
         super().__init__(f"not the leader (known leader: {leader_id})")
         self.leader_id = leader_id
+
+
+class ConfigChangeInFlight(Exception):
+    def __init__(self, index: int, reason: Optional[str] = None):
+        super().__init__(
+            reason
+            or f"a membership change at index {index} is not yet "
+               f"committed; one change at a time"
+        )
+        self.index = index

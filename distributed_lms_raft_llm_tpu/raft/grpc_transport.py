@@ -135,12 +135,22 @@ class GrpcTransport(Transport):
         self.rpc_timeout = rpc_timeout
         self._stubs: Dict[int, rpc.RaftServiceStub] = {}
         self._channels: Dict[int, grpc.aio.Channel] = {}
+        self._dialed: Dict[int, str] = {}  # address each channel went to
 
     def _stub(self, peer: int) -> rpc.RaftServiceStub:
+        # Re-dial when a runtime membership change moved the peer (the
+        # runner updates self.addresses; a server removed and re-added on
+        # a new port must not be messaged at its stale channel forever).
+        if peer in self._stubs and self._dialed[peer] != self.addresses[peer]:
+            old = self._channels.pop(peer)
+            self._stubs.pop(peer)
+            asyncio.ensure_future(old.close(None))
         if peer not in self._stubs:
-            channel = grpc.aio.insecure_channel(self.addresses[peer])
+            address = self.addresses[peer]
+            channel = grpc.aio.insecure_channel(address)
             self._channels[peer] = channel
             self._stubs[peer] = rpc.RaftServiceStub(channel)
+            self._dialed[peer] = address
         return self._stubs[peer]
 
     async def send(self, peer: int, message):

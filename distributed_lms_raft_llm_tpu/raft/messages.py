@@ -91,3 +91,56 @@ def decode_command(command: str) -> Tuple[str, Dict[str, Any]]:
 
 
 NOOP = encode_command("noop")
+
+# Cluster-membership change entries (Raft §4, one-server-at-a-time — each
+# consecutive configuration shares a quorum with the previous one, so no
+# joint consensus is needed). The entry carries the FULL new membership as
+# an id -> address map; it takes effect on every node as soon as it is
+# APPENDED to that node's log (not when committed), per the thesis.
+MEMBERSHIP_OP = "__membership__"
+
+
+def encode_membership(members: Dict[int, str]) -> str:
+    return encode_command(
+        MEMBERSHIP_OP, {"members": {str(k): v for k, v in members.items()}}
+    )
+
+
+def decode_membership(command: str) -> Dict[int, str]:
+    _, args = decode_command(command)
+    return {int(k): v for k, v in args["members"].items()}
+
+
+_SNAP_MAGIC = b"\x00mbr\x00"
+
+
+def wrap_snapshot(members: Dict[int, str], data: bytes) -> bytes:
+    """Envelope the membership-at-snapshot into the InstallSnapshot payload
+    (the frozen wire message has no config field; the thesis requires
+    snapshots to carry the latest configuration, or a follower restored
+    from one silently keeps a stale quorum view)."""
+    header = json.dumps({str(k): v for k, v in members.items()}).encode()
+    return _SNAP_MAGIC + len(header).to_bytes(4, "big") + header + data
+
+
+def unwrap_snapshot(data: bytes):
+    """-> (members | None, app_data). Non-enveloped payloads pass through."""
+    if not data.startswith(_SNAP_MAGIC):
+        return None, data
+    off = len(_SNAP_MAGIC)
+    n = int.from_bytes(data[off:off + 4], "big")
+    header = data[off + 4 : off + 4 + n]
+    members = {int(k): v for k, v in json.loads(header.decode()).items()}
+    return members, data[off + 4 + n:]
+
+
+def is_membership(command: str) -> bool:
+    """Cheap-substring fast path, full decode to confirm (an application
+    command whose ARGUMENTS contain the literal must not be mistaken)."""
+    if '"__membership__"' not in command:
+        return False
+    try:
+        op, _ = decode_command(command)
+    except (ValueError, json.JSONDecodeError):
+        return False
+    return op == MEMBERSHIP_OP

@@ -34,6 +34,7 @@ from .messages import (
     InstallSnapshotResponse,
     VoteRequest,
     VoteResponse,
+    is_membership,
 )
 
 log = logging.getLogger(__name__)
@@ -84,6 +85,11 @@ class RaftNode:
         self._read_barrier: Optional[asyncio.Future] = None
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
+        # Observer for membership changes (id -> address map); the LMS node
+        # uses it to keep its file-replication peer list current.
+        self.membership_cb: Optional[Callable[[Dict[int, str]], None]] = None
+        self._last_members = dict(self.core.members)
+        self._sync_transport_addresses()
 
     # -------------------------------------------------------------- public
 
@@ -120,6 +126,18 @@ class RaftNode:
     async def propose(self, command: str, timeout: float = 10.0) -> int:
         """Replicate `command`; resolves with its index once COMMITTED."""
         index = self.core.propose(command, time.monotonic())
+        return await self._await_commit(index, timeout)
+
+    async def propose_config(
+        self, members: Dict[int, str], timeout: float = 10.0
+    ) -> int:
+        """Change cluster membership by one server (add or remove); the new
+        id -> address map takes effect on this leader immediately and the
+        call resolves once the change entry COMMITS under the new quorum."""
+        index = self.core.propose_config(members, time.monotonic())
+        return await self._await_commit(index, timeout)
+
+    async def _await_commit(self, index: int, timeout: float) -> int:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._commit_waiters.setdefault(index, []).append(
             (self.core.current_term, fut)
@@ -206,15 +224,39 @@ class RaftNode:
             self._pump()
             await asyncio.sleep(self.tick_interval)
 
+    def _sync_transport_addresses(self) -> None:
+        """Push membership addresses into an address-keyed transport (the
+        gRPC transport dials by core membership; MemTransport has none)."""
+        addr = getattr(self.transport, "addresses", None)
+        if addr is None:
+            return
+        for nid, address in self.core.members.items():
+            if address:
+                addr[nid] = address
+
     def _pump(self) -> None:
         """Apply newly committed entries and dispatch outbound messages."""
         for index, entry in self.core.take_applies():
             self._resolve_waiters(index, entry)
-            if self.apply_cb is not None and entry.command != NOOP:
+            # Membership entries configure raft itself (applied on append,
+            # core._refresh_membership) — they never reach the app FSM.
+            if (
+                self.apply_cb is not None
+                and entry.command != NOOP
+                and not is_membership(entry.command)
+            ):
                 try:
                     self.apply_cb(index, entry)
                 except Exception:
                     log.exception("apply callback failed at index %d", index)
+        if self.core.members != self._last_members:
+            self._last_members = dict(self.core.members)
+            self._sync_transport_addresses()
+            if self.membership_cb is not None:
+                try:
+                    self.membership_cb(dict(self.core.members))
+                except Exception:
+                    log.exception("membership callback failed")
         if self.core.role is not Role.LEADER:
             self._fail_waiters(NotLeader(self.core.leader_id))
         for peer, message in self.core.drain_outbox():

@@ -17,6 +17,8 @@ Records:
     {"t": "entry", "i": index, "term": N, "cmd": "..."}
     {"t": "trunc", "i": index}          # delete entries >= index
     {"t": "snap", "i": index, "term": N}  # prefix <= index now snapshot-covered
+    {"t": "members", "m": {"id": "addr", ...}}  # base membership (see
+        RaftCore: membership entries compacted out of the log fold here)
 
 Compaction rewrites the file from live state (snap record + surviving
 suffix) when it grows past a bound or when `compact_to` is called.
@@ -46,6 +48,12 @@ class MemoryStorage:
         self.entries: List[Entry] = []
         self.snapshot_index = 0
         self.snapshot_term = 0
+        # Membership as of snapshot_index (id -> address); None = the core
+        # falls back to its boot-time peer list. See RaftCore membership.
+        self.members = None
+
+    def save_members(self, members) -> None:
+        self.members = dict(members)
 
     def load(self) -> LoadResult:
         return (self.term, self.voted_for, list(self.entries),
@@ -92,6 +100,7 @@ class FileStorage:
         self._entries: List[Entry] = []
         self._snapshot_index = 0
         self._snapshot_term = 0
+        self._members = None
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._replay()
         self._fh = open(self.path, "a", encoding="utf-8")
@@ -130,6 +139,10 @@ class FileStorage:
                             del self._entries[:drop]
                             self._snapshot_index = idx
                             self._snapshot_term = rec["term"]
+                    elif kind == "members":
+                        self._members = {
+                            int(k): v for k, v in rec["m"].items()
+                        }
                 good_offset += len(raw)
         # Drop any torn tail so the next append starts on a clean line —
         # otherwise the new record merges into the partial one and the
@@ -151,6 +164,17 @@ class FileStorage:
             os.fsync(self._fh.fileno())
         if self._fh.tell() > self.compact_every_bytes:
             self._compact()
+
+    @property
+    def members(self):
+        return None if self._members is None else dict(self._members)
+
+    def save_members(self, members) -> None:
+        self._members = {int(k): v for k, v in dict(members).items()}
+        self._write({
+            "t": "members",
+            "m": {str(k): v for k, v in self._members.items()},
+        })
 
     def save_meta(self, term: int, voted_for: Optional[int]) -> None:
         self._term = term
@@ -193,6 +217,11 @@ class FileStorage:
             f.write(json.dumps(
                 {"t": "meta", "term": self._term, "voted_for": self._voted_for}
             ) + "\n")
+            if self._members is not None:
+                f.write(json.dumps({
+                    "t": "members",
+                    "m": {str(k): v for k, v in self._members.items()},
+                }) + "\n")
             if self._snapshot_index:
                 f.write(json.dumps(
                     {"t": "snap", "i": self._snapshot_index,

@@ -88,7 +88,9 @@ async def serve_async(args) -> None:
         tutoring_address=args.tutoring,
         tutoring_auth_key=tutoring_auth_key,
         metrics=metrics,
-        peer_addresses=addresses,
+        # The LMSNode's map, mutated by runtime membership changes — the
+        # servicer holds it live so blob fetch-on-miss tracks the cluster.
+        peer_addresses=lms_node.addresses,
         self_id=args.id,
         linearizable_reads=args.linearizable_reads,
     )
@@ -109,6 +111,33 @@ async def serve_async(args) -> None:
     server.add_insecure_port(f"[::]:{args.port}")
     await server.start()
     await lms_node.start()
+    async def admin(path: str, body: Dict) -> Dict:
+        """POST /admin/membership {"op": "add"|"remove", "id": N,
+        "address": "host:port"} — single-server Raft membership change on
+        the leader (raft/core.py §4 machinery). The admin plane rides the
+        local HTTP endpoint, keeping the gRPC wire contract frozen."""
+        if path != "/admin/membership":
+            raise KeyError(path)
+        op = body.get("op")
+        if op not in ("add", "remove"):
+            raise ValueError("op must be 'add' or 'remove'")
+        if "id" not in body:
+            raise ValueError("missing 'id'")
+        nid = int(body["id"])
+        if op == "add" and "address" not in body:
+            raise ValueError("'add' requires 'address'")
+        members = {
+            k: lms_node.addresses.get(k, v)
+            for k, v in lms_node.node.core.members.items()
+        }
+        if op == "add":
+            members[nid] = str(body["address"])
+        else:
+            members.pop(nid, None)
+        index = await lms_node.node.propose_config(members)
+        return {"ok": True, "index": index,
+                "members": {str(k): v for k, v in members.items()}}
+
     health = None
     if args.metrics_port is not None:
         from ..utils.healthz import HealthServer
@@ -121,7 +150,11 @@ async def serve_async(args) -> None:
                 "role": "leader" if lms_node.node.is_leader else "follower",
                 "leader_id": lms_node.node.leader_id,
                 "applied_index": lms_node.node.core.last_applied,
+                "members": {
+                    str(k): v for k, v in lms_node.node.core.members.items()
+                },
             },
+            admin=admin,
             port=args.metrics_port,
         )
         bound = await health.start()

@@ -7,6 +7,10 @@ can scrape without a gRPC client:
 
     GET /healthz  -> {"ok": true, "role": "leader", ...}
     GET /metrics  -> the Metrics.snapshot() JSON
+    POST /admin/* -> optional admin hook (e.g. cluster membership change
+                     on the LMS leader: serving/lms_server.py) — JSON body
+                     in, JSON out; the admin plane stays off the frozen
+                     gRPC wire contract
 
 Serving is a ~60-line asyncio protocol rather than http.server-in-a-thread
 so it shares the node's event loop (single-threaded by construction, like
@@ -22,6 +26,9 @@ from typing import Awaitable, Callable, Dict, Optional
 from .metrics import Metrics
 
 Provider = Callable[[], Dict]
+# (path, body) -> response dict; raise KeyError for unknown paths,
+# ValueError for bad requests.
+AdminHandler = Callable[[str, Dict], Awaitable[Dict]]
 
 
 class HealthServer:
@@ -30,11 +37,13 @@ class HealthServer:
         metrics: Metrics,
         *,
         health: Optional[Provider] = None,
+        admin: Optional[AdminHandler] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
         self.metrics = metrics
         self.health = health or (lambda: {"ok": True})
+        self.admin = admin
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -59,23 +68,51 @@ class HealthServer:
         try:
             request_line = await asyncio.wait_for(reader.readline(), 5.0)
             parts = request_line.decode("latin-1").split()
+            method = parts[0].upper() if parts else "GET"
             path = parts[1] if len(parts) >= 2 else "/"
-            # Drain headers (ignore content: GET only).
+            content_length = 0
             while True:
                 line = await asyncio.wait_for(reader.readline(), 5.0)
                 if line in (b"\r\n", b"\n", b""):
                     break
+                if line.lower().startswith(b"content-length:"):
+                    try:
+                        content_length = max(0, int(line.split(b":", 1)[1]))
+                    except ValueError:
+                        pass
             if path == "/healthz":
                 body, status = json.dumps(self.health()), 200
             elif path == "/metrics":
                 body, status = json.dumps(self.metrics.snapshot()), 200
+            elif (
+                method == "POST"
+                and path.startswith("/admin/")
+                and self.admin is not None
+            ):
+                raw = b""
+                if content_length:
+                    raw = await asyncio.wait_for(
+                        reader.readexactly(min(content_length, 1 << 20)), 5.0
+                    )
+                try:
+                    req = json.loads(raw.decode() or "{}")
+                    body, status = json.dumps(await self.admin(path, req)), 200
+                except KeyError:
+                    body, status = json.dumps({"error": "not found"}), 404
+                except ValueError as e:
+                    body, status = json.dumps({"error": str(e)}), 400
+                except Exception as e:  # surfaced, not swallowed
+                    body, status = json.dumps({"error": str(e)}), 500
             else:
                 body, status = json.dumps({"error": "not found"}), 404
             payload = body.encode()
+            reason = {
+                200: "OK", 400: "Bad Request", 404: "Not Found",
+                500: "Internal Server Error",
+            }.get(status, "Error")
             writer.write(
                 (
-                    f"HTTP/1.1 {status} "
-                    f"{'OK' if status == 200 else 'Not Found'}\r\n"
+                    f"HTTP/1.1 {status} {reason}\r\n"
                     "Content-Type: application/json\r\n"
                     f"Content-Length: {len(payload)}\r\n"
                     "Connection: close\r\n\r\n"
@@ -83,7 +120,9 @@ class HealthServer:
                 + payload
             )
             await writer.drain()
-        except (asyncio.TimeoutError, ConnectionError):
+        except (asyncio.TimeoutError, ConnectionError, EOFError):
+            # EOFError covers IncompleteReadError: a client that closes
+            # mid-body gets no response (its connection is gone anyway).
             pass
         finally:
             writer.close()

@@ -75,3 +75,60 @@ def test_tutoring_server_exposes_endpoint():
         await server._queue.close()
 
     asyncio.run(run())
+
+
+async def _post(port: int, path: str, payload: dict):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    body = json.dumps(payload).encode()
+    writer.write(
+        f"POST {path} HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    await writer.wait_closed()
+    head, _, resp = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), json.loads(resp)
+
+
+def test_admin_endpoint_roundtrip_and_errors():
+    """POST /admin/* dispatches to the admin hook with the parsed JSON
+    body; unknown paths 404, ValueErrors 400, other failures 500."""
+    calls = []
+
+    async def admin(path, body):
+        if path != "/admin/membership":
+            raise KeyError(path)
+        if body.get("op") not in ("add", "remove"):
+            raise ValueError("op must be 'add' or 'remove'")
+        if body.get("boom"):
+            raise RuntimeError("kaput")
+        calls.append(body)
+        return {"ok": True, "index": 7}
+
+    async def run():
+        hs = HealthServer(Metrics(), admin=admin)
+        port = await hs.start()
+        try:
+            status, body = await _post(
+                port, "/admin/membership",
+                {"op": "add", "id": 6, "address": "127.0.0.1:9"},
+            )
+            assert status == 200 and body == {"ok": True, "index": 7}
+            assert calls and calls[0]["id"] == 6
+            status, body = await _post(port, "/admin/nope", {})
+            assert status == 404
+            status, body = await _post(port, "/admin/membership", {"op": "x"})
+            assert status == 400 and "op must be" in body["error"]
+            status, body = await _post(
+                port, "/admin/membership", {"op": "add", "boom": True}
+            )
+            assert status == 500
+            # GET to an admin path stays 404 (POST-only plane).
+            status, _ = await _get(port, "/admin/membership")
+            assert status == 404
+        finally:
+            await hs.stop()
+
+    asyncio.run(run())

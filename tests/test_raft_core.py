@@ -30,10 +30,18 @@ def test_follower_times_out_and_starts_election():
     assert c.role is Role.FOLLOWER and not c.outbox
     c.tick(1.1)
     assert c.role is Role.CANDIDATE
-    assert c.current_term == 1
-    assert c.voted_for == 1
-    peers_messaged = {p for p, m in c.outbox if isinstance(m, VoteRequest)}
-    assert peers_messaged == {2, 3}
+    # Pre-vote semantics on the frozen wire: the candidate CAMPAIGNS with
+    # term 1 but adopts (persists, self-votes) it only when a voter
+    # acknowledges — disregarded campaigns never inflate terms.
+    assert c.current_term == 0 and c._proposed_term == 1
+    reqs = [(p, m) for p, m in c.outbox if isinstance(m, VoteRequest)]
+    assert {p for p, _ in reqs} == {2, 3}
+    assert all(m.term == 1 for _, m in reqs)
+    from distributed_lms_raft_llm_tpu.raft.messages import VoteResponse
+
+    c.on_vote_response(2, VoteResponse(term=1, granted=True), 1.2)
+    assert c.current_term == 1 and c.voted_for == 1
+    assert c.role is Role.LEADER  # self + peer 2 = quorum of 3
 
 
 def test_vote_granted_once_per_term():
@@ -150,9 +158,13 @@ def test_step_down_on_higher_term_response():
 
 
 def test_restart_recovers_persistent_state():
+    from distributed_lms_raft_llm_tpu.raft.messages import VoteResponse
+
     storage = MemoryStorage()
     c = make(storage=storage)
-    c.tick(1.1)  # term -> 1, votes for self
+    c.tick(1.1)  # campaigns with proposed term 1
+    c.on_vote_response(2, VoteResponse(term=1, granted=True), 1.2)
+    assert c.current_term == 1  # adopted on acknowledgment, persisted
     incarnation2 = make(storage=storage)
     assert incarnation2.current_term == 1
     assert incarnation2.voted_for == 1
