@@ -157,10 +157,10 @@ class LMSServicer(rpc.LMSServicer):
         if self._blob_missing.get(rel_path, 0.0) > now:
             return b""  # recently swept the peers; don't stall every read
         leader = self.node.leader_id
-        ordered = sorted(
-            self._peer_addresses,
-            key=lambda pid: (pid != leader, pid),
-        )
+        # Snapshot: _peer_addresses is LIVE (runtime membership changes
+        # mutate it mid-await); a removed peer simply stops being tried.
+        peers = dict(self._peer_addresses)
+        ordered = sorted(peers, key=lambda pid: (pid != leader, pid))
         for pid in ordered:
             if pid == self._self_id:
                 continue
@@ -168,7 +168,7 @@ class LMSServicer(rpc.LMSServicer):
                 # Same 50 MiB cap the upload path accepts — the default
                 # 4 MiB receive cap would make any larger blob unfetchable.
                 async with grpc.aio.insecure_channel(
-                    self._peer_addresses[pid],
+                    peers[pid],
                     options=[("grpc.max_receive_message_length",
                               50 * 1024 * 1024)],
                 ) as channel:
@@ -579,7 +579,9 @@ async def replicate_file_to_peers(
     if data is None:
         return {}
     results: Dict[int, str] = {}
-    for peer, addr in addresses.items():
+    # Snapshot: the caller passes LMSNode's live map, which runtime
+    # membership changes mutate between this coroutine's awaits.
+    for peer, addr in list(addresses.items()):
         if peer == self_id:
             continue
         try:

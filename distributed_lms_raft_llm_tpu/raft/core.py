@@ -330,7 +330,7 @@ class RaftCore:
     def _adopt_candidacy(self) -> bool:
         """Persist the proposed term + self-vote; False if this term is
         already spoken for (we granted another candidate meanwhile)."""
-        proposed = getattr(self, "_proposed_term", self.current_term)
+        proposed = self._proposed_term
         if self.current_term > proposed:
             return False
         if self.current_term == proposed:
@@ -388,7 +388,7 @@ class RaftCore:
         return VoteResponse(term=self.current_term, granted=granted)
 
     def on_vote_response(self, peer: int, resp: VoteResponse, now: float) -> None:
-        proposed = getattr(self, "_proposed_term", self.current_term)
+        proposed = self._proposed_term
         if resp.term > max(self.current_term, proposed):
             self._step_down(resp.term, now)
             return

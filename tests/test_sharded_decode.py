@@ -83,3 +83,69 @@ def test_tp_sharded_decode_with_int8_kv_cache():
     assert result.tokens.shape == (2, 4)
     assert (result.tokens < cfg.vocab_size).all()
     assert np.isfinite(result.lengths).all()
+
+
+def test_llama3_topology_tp8_gqa_decode():
+    """BASELINE config 5's sharding surface: Llama-3-8B's real head
+    topology (32 q heads over 8 kv heads -> exactly 1 kv head per device
+    at tp=8) at reduced width, through prefill + while_loop decode +
+    sampling with int8 KV, under tp=8 NamedShardings."""
+    from distributed_lms_raft_llm_tpu.models import llama, registry
+
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.llama3_8b(dtype=jnp.float32,
+                                    param_dtype=jnp.float32),
+        hidden_size=128,        # 32 heads x 4 head_dim (true: 32 x 128)
+        num_layers=4,
+        intermediate_size=256,
+        vocab_size=512,
+        max_position_embeddings=64,
+        quant_kv=True,
+    )
+    mesh = mesh_lib.make_mesh({"tp": 8, "dp": -1})
+    params = llama.init_params(jax.random.key(9), cfg)
+    params = partition.shard_tree(params, mesh, partition.LLAMA_RULES)
+    ids = np.ones((2, 16), np.int32)
+    mask = np.ones((2, 16), bool)
+    with mesh:
+        result = jax.jit(
+            lambda p, i, m, r: gen_lib.generate(
+                p, cfg, i, m, r,
+                sampling=SamplingParams.reference_defaults(max_new_tokens=4),
+                eos_id=0, pad_id=0, model=registry.LLAMA_FAMILY,
+            )
+        )(params, jnp.asarray(ids), jnp.asarray(mask), jax.random.key(2))
+    result = jax.device_get(result)
+    assert result.tokens.shape == (2, 4)
+    assert (result.tokens < cfg.vocab_size).all()
+    assert np.isfinite(result.lengths).all()
+
+
+def test_llama_int8_weights_tp4_decode():
+    """Llama int8 weight-only quant under tp=4 (the {q, s} LLAMA_RULES):
+    sharded generate runs and emits valid tokens."""
+    from distributed_lms_raft_llm_tpu.models import llama, quant, registry
+
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32),
+        hidden_size=64, num_layers=3, num_heads=8, num_kv_heads=4,
+        intermediate_size=128,
+    )
+    qparams = quant.quantize_params(
+        llama.init_params(jax.random.key(10), cfg), "llama"
+    )
+    mesh = mesh_lib.make_mesh({"tp": 4, "dp": -1})
+    sharded = partition.shard_tree(qparams, mesh, partition.LLAMA_RULES)
+    ids = np.ones((2, 12), np.int32)
+    mask = np.ones((2, 12), bool)
+    with mesh:
+        result = jax.jit(
+            lambda p, i, m, r: gen_lib.generate(
+                p, cfg, i, m, r,
+                sampling=SamplingParams.reference_defaults(max_new_tokens=4),
+                eos_id=0, pad_id=0, model=registry.LLAMA_FAMILY,
+            )
+        )(sharded, jnp.asarray(ids), jnp.asarray(mask), jax.random.key(3))
+    result = jax.device_get(result)
+    assert result.tokens.shape == (2, 4)
+    assert (result.tokens < cfg.vocab_size).all()
