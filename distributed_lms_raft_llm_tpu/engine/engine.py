@@ -71,8 +71,9 @@ class EngineConfig:
     # Decode-segment count: the KV cache grows to each segment's high-water
     # mark instead of being final-size from step one, so attention streams
     # only slots that can be valid yet (generate.decode; measured numbers
-    # in BENCH_NOTES.md). 1 = single full-size while_loop.
-    decode_segments: int = 4
+    # in BENCH_NOTES.md). None = auto from the batch size (4 small / 8
+    # large); 1 = single full-size while_loop.
+    decode_segments: Optional[int] = None
     dtype: Any = jnp.bfloat16
     # Serving stores weights in bf16: halves the HBM read per decode step
     # versus f32 (the decode loop is memory-bound — every step streams all

@@ -132,6 +132,7 @@ def test_chaos_with_membership_changes_preserves_safety():
             return f"127.0.0.1:{9100 + i}"
 
         next_id = 4
+        adds_landed = 0
         acked = []
         seq = 0
 
@@ -150,6 +151,10 @@ def test_chaos_with_membership_changes_preserves_safety():
                     RuntimeError):
                 pass
 
+        def nonlocal_adds():
+            nonlocal adds_landed
+            adds_landed += 1
+
         async def try_membership():
             nonlocal next_id
             leaders = [n for n in nodes.values()
@@ -161,14 +166,21 @@ def test_chaos_with_membership_changes_preserves_safety():
             grow = len(members) < 4 or (len(members) < 6 and rng.random() < 0.6)
             try:
                 if grow:
-                    nid = next_id
+                    # Consume the id up front: a timed-out add may still
+                    # commit later (Raft timeouts don't roll back), so the
+                    # id must NEVER be reused for a second instance — two
+                    # live nodes sharing one Raft identity would corrupt
+                    # the very invariants this soak asserts.
+                    nid, next_id = next_id, next_id + 1
                     storage = MemoryStorage()
+
+                    def cb(i, e, nid=nid):
+                        applied.setdefault(nid, []).append((i, e.command))
+
                     newborn = RaftNode(
                         nid, {**{k: addr(k) for k in members}, nid: addr(nid)},
                         storage, net.transport_for(nid),
-                        apply_cb=(lambda nid=nid: lambda i, e: applied
-                                  .setdefault(nid, []).append((i, e.command))
-                                  )(),
+                        apply_cb=cb,
                         config=FAST, tick_interval=0.01, seed=500 + nid,
                     )
                     net.register(newborn)
@@ -178,7 +190,7 @@ def test_chaos_with_membership_changes_preserves_safety():
                     await asyncio.wait_for(
                         leader.propose_config(members), 1.0
                     )
-                    next_id += 1
+                    nonlocal_adds()
                 else:
                     victim = rng.choice(
                         [i for i in members if i != leader.node_id]
@@ -237,7 +249,7 @@ def test_chaos_with_membership_changes_preserves_safety():
             assert reference_seq.count(cmd) == 1, f"acked write lost: {cmd}"
         assert len(acked) >= 3, "chaos schedule never committed anything"
         # The membership machinery actually exercised growth/shrink.
-        assert next_id > 4, "no add ever landed"
+        assert adds_landed > 0, "no add ever landed"
 
         for n in nodes.values():
             if not n._stopped:
