@@ -141,6 +141,23 @@ def _install_program(state: SlotState, slot, c1: KVCache, true_len, first,
     )
 
 
+def _grow_state_program(state: SlotState, new_len: int) -> SlotState:
+    """Zero-pad the cache's slot axis up to `new_len` (width-bucket growth:
+    the live cache is only as wide as the widest ACTIVE request needs —
+    see PagedEngine._admit — and pads up when a longer prompt arrives)."""
+    pad = [(0, 0), (0, 0), (0, 0),
+           (0, new_len - state.cache.k.shape[3]), (0, 0)]
+    cache = state.cache._replace(
+        k=jnp.pad(state.cache.k, pad),
+        v=jnp.pad(state.cache.v, pad),
+        ks=None if state.cache.ks is None else jnp.pad(state.cache.ks,
+                                                       pad[:-1]),
+        vs=None if state.cache.vs is None else jnp.pad(state.cache.vs,
+                                                       pad[:-1]),
+    )
+    return state._replace(cache=cache)
+
+
 def _step_program(params, state: SlotState, rng, *, cfg, sampling,
                   eos_id: int, pad_id: int, model,
                   chunk: int = 1) -> Tuple[SlotState, jax.Array, jax.Array]:
@@ -255,6 +272,16 @@ class PagedEngine:
                 f"{self.cfg.max_position_embeddings}"
             )
         self.tmax = cfg_tmax(self.cfg, config.sampling, self.bucket)
+        # Cache-width buckets: one admissible width per prompt bucket
+        # (bucket + max_new). The live cache runs at the width the widest
+        # ACTIVE request needs instead of always tmax — every decode step's
+        # attention streams the whole slot axis, so a cluster of short
+        # prompts pays ~half the KV bytes of the worst case (the bucketed
+        # engine's segmented decode, ported to the slot world).
+        self.widths = sorted({
+            cfg_tmax(self.cfg, config.sampling, min(b, self.bucket))
+            for b in config.length_buckets
+        })
 
         if config.checkpoint:
             sd = convert.load_safetensors(config.checkpoint)
@@ -284,6 +311,9 @@ class PagedEngine:
                     **statics),
             donate_argnums=(1,),
         )
+        self._grow = jax.jit(
+            _grow_state_program, static_argnums=(1,), donate_argnums=(0,)
+        )
         self._rng = jax.random.key(config.seed)
         self.state = self._init_state()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
@@ -300,9 +330,11 @@ class PagedEngine:
         # keyed by rid; the serving queue pops these into its histogram.
         self.ttfts: Dict[int, float] = {}
 
-    def _init_state(self) -> SlotState:
-        cache = self.family.init_cache(self.cfg, self.slots, self.tmax,
-                                       dtype=self.cfg.dtype)
+    def _init_state(self, width: Optional[int] = None) -> SlotState:
+        cache = self.family.init_cache(
+            self.cfg, self.slots, width or self.widths[0],
+            dtype=self.cfg.dtype,
+        )
         cache = cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
         return SlotState(
             cache=cache,
@@ -328,26 +360,40 @@ class PagedEngine:
         return req.rid
 
     def warmup(self) -> float:
-        """Compile the step program and EVERY prompt-bucket prefill AND
-        install program (both retrace per prompt width — a width first
-        seen mid-serving would pay its XLA compile on a live request);
-        returns seconds."""
+        """Compile the serving program set so no live request pays an XLA
+        compile: the step program at every cache width, each prompt
+        bucket's prefill, every admissible (prompt bucket, cache width)
+        install pair (a short prompt can join a batch running at any wider
+        width), and every width-growth transition. Returns seconds."""
         t0 = time.monotonic()
         buckets = sorted(
             {min(b, self.bucket) for b in self.config.length_buckets}
         )
-        for width in buckets:
-            ids = np.full((1, width), self.tokenizer.pad_id, np.int32)
+        for width in self.widths:
+            self.state = self._init_state(width)
             self._rng, rng = jax.random.split(self._rng)
             with self.mesh:
-                c1, first, seen_row = self._prefill(
-                    self.params, jnp.asarray(ids),
-                    jnp.asarray(1, jnp.int32), rng,
-                )
-                self.state = self._install(
-                    self.state, jnp.asarray(0, jnp.int32), c1,
-                    jnp.asarray(1, jnp.int32), first, seen_row,
-                )
+                self.state, _, _ = self._step(self.params, self.state, rng)
+            for t in buckets:
+                nat = cfg_tmax(self.cfg, self.config.sampling, t)
+                if nat > width:
+                    continue  # a prompt this long can't run at this width
+                ids = np.full((1, t), self.tokenizer.pad_id, np.int32)
+                self._rng, rng = jax.random.split(self._rng)
+                with self.mesh:
+                    c1, first, seen_row = self._prefill(
+                        self.params, jnp.asarray(ids),
+                        jnp.asarray(1, jnp.int32), rng,
+                    )
+                    self.state = self._install(
+                        self.state, jnp.asarray(0, jnp.int32), c1,
+                        jnp.asarray(1, jnp.int32), first, seen_row,
+                    )
+        for i, wa in enumerate(self.widths):
+            for wb in self.widths[i + 1:]:
+                throwaway = self._init_state(wa)
+                with self.mesh:
+                    self._grow(throwaway, wb)
         self.reset()  # drop the ghost installs; compiled programs stay cached
         rid = self.submit("warmup")
         self.drain()
@@ -386,6 +432,21 @@ class PagedEngine:
         # programs for every admitted request dispatch back-to-back and
         # pipeline on device; one blocking readback at the end fetches every
         # first token (instead of a per-request round-trip stall).
+        # Idle rebuild: with nothing occupied or in flight, the cache can
+        # jump straight to the width the queued work needs (free — it holds
+        # no live data), shrinking back after a wide request departs.
+        if (
+            self._pending
+            and not self._inflight
+            and not any(r is not None for r in self._slot_req)
+        ):
+            needed = max(
+                self._required_width(r.prompt_len)
+                for r in self._pending[: self.slots]
+            )
+            if needed != self.state.cache.k.shape[3]:
+                self.state = self._init_state(needed)
+
         admitted: List[Tuple[int, _Request, jax.Array]] = []
         for slot in range(self.slots):
             if self._slot_req[slot] is not None or not self._pending:
@@ -393,15 +454,22 @@ class PagedEngine:
             req = self._pending.pop(0)
             # Smallest length bucket that fits: a 10-token query prefills a
             # 16/32-wide program, not the full Tmax-wide one (one compiled
-            # prefill per bucket; the decode cache stays Tmax).
+            # prefill per bucket; the decode cache runs at the width the
+            # widest active request needs).
             bucket = min(
                 pick_bucket(req.prompt_len, self.config.length_buckets),
                 self.bucket,
             )
+            w_req = self._required_width(req.prompt_len)
             ids = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
             ids[0, : req.prompt_len] = req.tokens
             self._rng, rng = jax.random.split(self._rng)
             with self.mesh:
+                if w_req > self.state.cache.k.shape[3]:
+                    # Pad the live cache up (donated, in device order after
+                    # any in-flight chunks — their snapshots are separate
+                    # arrays and unaffected).
+                    self.state = self._grow(self.state, w_req)
                 c1, first, seen_row = self._prefill(
                     self.params, jnp.asarray(ids),
                     jnp.asarray(req.prompt_len, jnp.int32), rng,
@@ -421,6 +489,12 @@ class PagedEngine:
             ttft = now - req.submit_time
             self.ttfts[req.rid] = ttft
             self.last_ttft_s = ttft
+
+    def _required_width(self, prompt_len: int) -> int:
+        bucket = min(
+            pick_bucket(prompt_len, self.config.length_buckets), self.bucket
+        )
+        return cfg_tmax(self.cfg, self.config.sampling, bucket)
 
     def _live(self) -> bool:
         return any(r is not None and not r.finished for r in self._slot_req)

@@ -104,6 +104,36 @@ def test_greedy_parity_with_prompt_buckets_and_churn():
     assert len(widths) >= 2 and min(widths) < 16, widths
 
 
+def test_cache_width_grows_and_shrinks_with_prompt_mix():
+    """Width-bucketed slot cache: short prompts run at a narrow width, a
+    long prompt grows the live cache mid-batch, and an idle engine shrinks
+    back — all with exact greedy parity against the bucketed engine."""
+    cfg = make_config(length_buckets=(4, 16))
+    long_prompt = "a long question about raft elections and replicated logs"
+    prompts = ["k v", long_prompt, "hi"]
+    expected = TutoringEngine(cfg).answer_batch(prompts)
+
+    paged = PagedEngine(cfg, slots=2)
+    assert len(paged.widths) == 2  # (4 + 8, 16 + 8) admissible widths
+    narrow, wide = paged.widths
+    # Short prompt first: engine rebuilds/stays at the narrow width.
+    r0 = paged.submit(prompts[0])
+    paged.step()
+    assert paged.state.cache.k.shape[3] == narrow
+    # Long prompt arrives mid-decode: the live cache pads up.
+    r1 = paged.submit(prompts[1])
+    out = {}
+    while paged.has_work and len(out) < 2:
+        out.update(paged.step())
+    assert paged.state.cache.k.shape[3] == wide
+    # Idle, then a short prompt: rebuild shrinks back to narrow.
+    r2 = paged.submit(prompts[2])
+    while paged.has_work:
+        out.update(paged.step())
+    assert paged.state.cache.k.shape[3] == narrow
+    assert [out[r] for r in (r0, r1, r2)] == expected
+
+
 def test_slot_reuse_evict_then_readmit():
     """slots=1 forces the second request through an evict→re-admit cycle in
     the same slot; outputs must match sequential fresh-drain runs."""
