@@ -53,7 +53,8 @@ TORCH_CPU_FALLBACK_TPS = 15.0
 
 
 def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False,
-              batch: int = BATCH) -> dict:
+              batch: int = BATCH, spec_tokens: int = 0,
+              greedy: bool = False) -> dict:
     import jax
 
     from distributed_lms_raft_llm_tpu.engine import (
@@ -67,10 +68,14 @@ def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False,
     # (BASELINE configs 2-3: gpt2-medium single chip, gpt2-large tp-sharded
     # — pass --tp when more than one chip is attached).
     artifacts = ensure_local_artifacts() if model == "gpt2" else {}
+    sampling = (
+        SamplingParams.greedy(max_new_tokens=MAX_NEW) if greedy
+        else SamplingParams.reference_defaults(max_new_tokens=MAX_NEW)
+    )
     engine = TutoringEngine(
         EngineConfig(
             model=model,
-            sampling=SamplingParams.reference_defaults(max_new_tokens=MAX_NEW),
+            sampling=sampling,
             length_buckets=(PROMPT_LEN, 64, 128),
             batch_buckets=tuple(sorted({1, 2, 4, 8, batch})),
             tp=tp,
@@ -80,6 +85,7 @@ def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False,
             # full-precision bf16 path for continuity with earlier rounds.
             quant="int8" if quant else None,
             kv_quant=quant,
+            spec_tokens=spec_tokens,
             **artifacts,
         )
     )
@@ -174,6 +180,13 @@ def main() -> None:
                     help="tensor-parallel ways (config 4: gpt2-large tp)")
     ap.add_argument("--batch", type=int, default=BATCH,
                     help="device batch (BASELINE config is 8)")
+    ap.add_argument("--spec-tokens", type=int, default=0,
+                    help="speculative decoding draft window (engine/spec.py; "
+                         "exact). Measured win is on the greedy low-batch "
+                         "path — pair with --greedy --batch 1")
+    ap.add_argument("--greedy", action="store_true",
+                    help="temperature-0 sampling instead of the reference "
+                         "params (the speculative serving configuration)")
     ap.add_argument("--config", default=None,
                     help="TOML deployment file; [tutoring] model/tp apply")
     args = ap.parse_args()
@@ -186,13 +199,19 @@ def main() -> None:
             args.model = t.model
         if args.tp == 1:
             args.tp = t.tp
-    quant = (bench_tpu(args.model, args.tp, quant=True, batch=args.batch)
+    extra = dict(spec_tokens=args.spec_tokens, greedy=args.greedy)
+    quant = (bench_tpu(args.model, args.tp, quant=True, batch=args.batch,
+                       **extra)
              if args.tp == 1 else None)
-    tpu = bench_tpu(args.model, args.tp, batch=args.batch)
+    tpu = bench_tpu(args.model, args.tp, batch=args.batch, **extra)
     baseline_tps = bench_torch_baseline(args.model)
     name = {"gpt2": "gpt2_small"}.get(args.model, args.model.replace("-", "_"))
     if args.tp > 1:
         name += f"_tp{args.tp}"
+    if args.greedy:
+        name += "_greedy"
+    if args.spec_tokens:
+        name += f"_spec{args.spec_tokens}"
     head = quant or tpu  # headline = the production serving config
     value = round(head["tokens_per_sec_per_chip"], 2)
     record = {

@@ -68,6 +68,7 @@ class TutoringConfig:
     tp: int = 1
     quant: Optional[str] = None  # "int8" = weight-only int8
     kv_quant: bool = False
+    spec_tokens: int = 0         # speculative decoding draft window (exact)
     paged: bool = False          # continuous batching
     max_batch: int = 8
     max_wait_ms: float = 10.0
@@ -201,7 +202,7 @@ def engine_config(cfg: AppConfig):
         model=t.model, checkpoint=t.checkpoint, vocab_path=t.vocab,
         merges_path=t.merges, tokenizer_json=t.tokenizer_json,
         sampling=sampling_params(cfg), tp=t.tp, quant=t.quant,
-        kv_quant=t.kv_quant,
+        kv_quant=t.kv_quant, spec_tokens=t.spec_tokens,
     )
 
 

@@ -74,6 +74,13 @@ class EngineConfig:
     # in BENCH_NOTES.md). None = auto from the batch size (4 small / 8
     # large); 1 = single full-size while_loop.
     decode_segments: Optional[int] = None
+    # Speculative decoding (engine/spec.py): propose this many prompt-lookup
+    # draft tokens per step and verify them in one forward with exact
+    # rejection sampling — several tokens per model call, identical output
+    # distribution. 0 = off. Wins where per-step fixed costs dominate (the
+    # batch-1..4 single-student latency path); supersedes decode_segments
+    # when set (the spec cache grows once to its high-water width).
+    spec_tokens: int = 0
     dtype: Any = jnp.bfloat16
     # Serving stores weights in bf16: halves the HBM read per decode step
     # versus f32 (the decode loop is memory-bound — every step streams all
@@ -86,6 +93,12 @@ class TutoringEngine:
     def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None):
         enable_compilation_cache()
         self.config = config
+        if config.spec_tokens > 0 and config.fused_attention:
+            raise ValueError(
+                "spec_tokens and fused_attention are mutually exclusive: "
+                "the pallas decode kernel is single-query, the verify "
+                "window is k+1 wide"
+            )
         self.family, self.cfg = registry.resolve(
             config.model, config.dtype, config.param_dtype
         )
@@ -166,17 +179,30 @@ class TutoringEngine:
             model=self.family,
         )
         self._prefill = jax.jit(partial(prefill, **statics))
-        self._decode = jax.jit(
-            partial(decode, segments=config.decode_segments, **statics),
-            donate_argnums=(1,),
-        )
+        if config.spec_tokens > 0:
+            from .spec import decode_spec
+
+            self._decode = jax.jit(
+                partial(decode_spec, spec_tokens=config.spec_tokens,
+                        **statics),
+                donate_argnums=(1,),
+            )
+        else:
+            self._decode = jax.jit(
+                partial(decode, segments=config.decode_segments, **statics),
+                donate_argnums=(1,),
+            )
         self.last_ttft_s: Optional[float] = None
         self.last_batch_ttfts: List[float] = []
 
     def _max_prompt_len(self) -> int:
+        # Spec mode keeps its verify windows inside the position table:
+        # the widest window ends k-1 positions past the last budgeted token.
+        extra = max(0, self.config.spec_tokens - 1)
         return min(
             max(self.config.length_buckets),
-            self.cfg.max_position_embeddings - self.config.sampling.max_new_tokens,
+            self.cfg.max_position_embeddings
+            - self.config.sampling.max_new_tokens - extra,
         )
 
     def encode_prompts(self, prompts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray, int]:
@@ -249,7 +275,10 @@ class TutoringEngine:
             # The final state is returned (and dropped) so the donated input
             # state's same-shaped buffers (out/seen/rng/flags) alias into the
             # outputs; the cache intentionally grows instead — see decode().
-            result, _ = self._decode(self.params, state)
+            if self.config.spec_tokens > 0:
+                result, _ = self._decode(self.params, state, jnp.asarray(ids))
+            else:
+                result, _ = self._decode(self.params, state)
         return result if device_result else jax.device_get(result)
 
     def answer_batch(self, prompts: Sequence[str]) -> List[str]:

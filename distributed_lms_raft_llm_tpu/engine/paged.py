@@ -250,6 +250,13 @@ class PagedEngine:
                 "fused_attention is not supported by the paged engine "
                 "(per-slot ragged cache offsets); use TutoringEngine"
             )
+        if config.spec_tokens:
+            # The chunked step program decodes one token per slot per step;
+            # a speculative verify window doesn't fit its admission model.
+            raise ValueError(
+                "spec_tokens is not supported by the paged engine; use "
+                "TutoringEngine for speculative decoding"
+            )
         self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1},
                                        devices=devices)
         self.tokenizer = tok_lib.load_gpt2_tokenizer(

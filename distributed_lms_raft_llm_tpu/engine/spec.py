@@ -1,0 +1,371 @@
+"""Speculative decoding with prompt-lookup drafting (exact, jit-native).
+
+The reference decodes strictly one token per model call (reference:
+GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29 — HF `generate`'s
+autoregressive loop). This module emits SEVERAL tokens per model call
+while sampling from *exactly* the same distribution:
+
+- **Drafting** is prompt-lookup (n-gram) speculation: the most recent
+  earlier occurrence of the current (previous, last)-token bigram in the
+  row's transcript — falling back to a unigram match — proposes the k
+  tokens that followed it. Tutoring answers restate prompt phrases and
+  their own earlier sentences constantly, which is exactly the regime
+  where lookup drafting hits. No draft model, no extra weights, no extra
+  HBM traffic.
+- **Verification** runs the target model ONCE over [last_tok, d_1..d_k]
+  (k+1 positions; the KV write scatters at per-row ragged slots — see
+  gpt2.forward), then walks the k drafts with rejection sampling:
+  draft d_i is accepted with probability p_i(d_i) — its probability
+  under the FULL processed distribution (repetition penalty with the
+  seen-set as of that position, temperature, top-k, top-p) — and the
+  first rejection resamples from the residual distribution (p with the
+  rejected token removed, renormalized), which for a deterministic
+  (point-mass) draft is exactly the leftover-probability rule of
+  speculative sampling [Leviathan et al. 2023; Chen et al. 2023]. If all
+  k drafts survive, a bonus token samples from the (k+1)-th logit row.
+  Every emitted token is therefore distributed identically to the
+  non-speculative sampler — greedy (temperature=0) streams are
+  bit-identical, stochastic streams are distribution-identical (tested
+  both ways in tests/test_spec.py).
+
+Per-row bookkeeping: rows accept different draft counts, so the decode
+state tracks per-row generated counts `n` and the cache takes per-row
+slot offsets. A row's verify window [t+n-1, t+n-1+k] always covers every
+garbage slot its previous window may have left behind (the window start
+advances by the number of emitted tokens ≥ 1 while the width stays k+1),
+and the causal mask (key slot ≤ query slot) hides the not-yet-valid tail
+within a window — so no dynamic KV-validity state is needed beyond the
+static prompt padding mask.
+
+Cost shape: the verify forward streams the same parameter and KV bytes
+as ONE ordinary decode step (both are bandwidth-bound; the extra k
+query positions are FLOP-cheap), but sampling runs k+1 times per step.
+The win is therefore largest where per-step fixed costs dominate —
+small batches, i.e. the single-student latency path — and the feature
+is opt-in (`EngineConfig.spec_tokens`, `tutoring_server --spec-tokens`).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import registry
+from ..models.common import KVCache
+from ..models.registry import ModelFamily
+from .generate import DecodeState, GenerateResult, _grow_cache
+from .sampling import NEG_INF, SamplingParams, apply_repetition_penalty
+
+
+class SpecState(NamedTuple):
+    """Carry of the speculative decode loop (per-row progress)."""
+
+    cache: KVCache
+    transcript: jax.Array  # [B, t + max_new] prompt slots then generated slots
+    rng: jax.Array
+    out: jax.Array         # [B, max_new] emitted tokens (pad after EOS/budget)
+    seen: jax.Array        # [B, V] repetition-penalty presence mask
+    done: jax.Array        # [B]
+    n: jax.Array           # [B] tokens generated so far (== lengths)
+    real_lens: jax.Array   # [B] true prompt lengths (position base)
+    kv_mask: jax.Array     # [B, cache_width] key-slot validity
+    windows: jax.Array     # [] verify windows run — sum(n)/windows/B is the
+    #                        mean tokens-per-window (acceptance observability)
+
+
+def build_drafts(
+    transcript: jax.Array,
+    match_valid: jax.Array,
+    prev_tok: jax.Array,
+    last_tok: jax.Array,
+    k: int,
+) -> jax.Array:
+    """Prompt-lookup proposals: [B, k] continuation of the best n-gram match.
+
+    transcript [B, W] token ids; match_valid [B, W] marks slots that may
+    anchor a match (filled AND followed by at least one filled slot).
+    Bigram matches (prev_tok, last_tok) outrank unigram matches
+    (last_tok); ties break toward recency. Rows with no match propose
+    `last_tok` repeated — a throwaway draft the verifier will almost
+    surely reject, costing nothing extra (the verify forward runs at
+    static width regardless).
+    """
+    b, w = transcript.shape
+    pos = jnp.arange(w, dtype=jnp.int32)
+    uni = (transcript == last_tok[:, None]) & match_valid
+    prev_ids = jnp.concatenate(
+        [jnp.full_like(transcript[:, :1], -1), transcript[:, :-1]], axis=1
+    )
+    prev_ok = jnp.concatenate(
+        [jnp.zeros_like(match_valid[:, :1]), match_valid[:, :-1]], axis=1
+    )
+    bi = uni & prev_ok & (prev_ids == prev_tok[:, None])
+    score = uni.astype(jnp.int32) + bi.astype(jnp.int32)  # 0 | 1 | 2
+    best = jnp.argmax(score * w + pos[None, :], axis=1)   # [B]
+    has = jnp.max(score, axis=1) > 0
+    idx = best[:, None] + 1 + jnp.arange(k, dtype=jnp.int32)[None, :]
+    drafts = jnp.take_along_axis(transcript, jnp.minimum(idx, w - 1), axis=1)
+    return jnp.where(has[:, None], drafts, last_tok[:, None])
+
+
+def _processed_top(
+    logits: jax.Array, seen: jax.Array, params: SamplingParams
+) -> Tuple[jax.Array, jax.Array]:
+    """(filtered_vals [B, K], idx [B, K]) — the processed distribution's
+    support, matching sample_step's pipeline: repetition penalty, then
+    temperature, then top-k, then top-p (NEG_INF outside the nucleus).
+    With top_k disabled the support is the whole vocab."""
+    logits = apply_repetition_penalty(logits, seen, params.repetition_penalty)
+    temp = params.temperature if params.temperature > 0 else 1.0
+    logits = logits / temp
+    k = params.top_k
+    if 0 < k < logits.shape[-1]:
+        if params.approx_top_k:
+            vals, idx = jax.lax.approx_max_k(logits, k)
+        else:
+            vals, idx = jax.lax.top_k(logits, k)
+    else:
+        vals = jnp.sort(logits, axis=-1)[..., ::-1]
+        idx = jnp.argsort(logits, axis=-1)[..., ::-1]
+    if params.top_p < 1.0:
+        probs = jax.nn.softmax(vals, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        vals = jnp.where((cum - probs) > params.top_p, NEG_INF, vals)
+    return vals, idx.astype(jnp.int32)
+
+
+def verify_window(
+    rng: jax.Array,
+    logits: jax.Array,
+    drafts: jax.Array,
+    seen: jax.Array,
+    active_in: jax.Array,
+    sampling: SamplingParams,
+    eos_id: int,
+    pad_id: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Walk one verify window; returns (emitted [B,k+1], valid [B,k+1],
+    seen', hit_eos [B]).
+
+    logits[:, i] is the model's next-token distribution given the prefix
+    plus drafts d_1..d_i; draft d_{i+1} is checked against logits[:, i].
+    Rows enter with `active_in` (False = already done, emit nothing).
+
+    The sampling pipeline runs ONCE, batched over all k+1 positions:
+    position i's distribution only matters if drafts 1..i were all
+    accepted, in which case its repetition-penalty seen-set is exactly
+    `seen ∪ {d_1..d_i}` — known before any accept/reject decision. So the
+    whole window pays roughly one step's sampling cost (the first
+    implementation ran k+1 sequential passes and lost its speedup to
+    them); the per-position walk that follows touches only [B, top_k]
+    slices and scalars.
+    """
+    b, k1, v = logits.shape
+    k = k1 - 1
+    greedy = sampling.temperature <= 0.0
+    logits = logits.astype(jnp.float32)
+
+    stacks = [seen]
+    for i in range(k):
+        stacks.append(
+            stacks[-1] | jax.nn.one_hot(drafts[:, i], v, dtype=jnp.bool_)
+        )
+    seen_stack = jnp.stack(stacks, axis=1)  # [B, k+1, V] hypothetical
+
+    if greedy:
+        # Deterministic fast path: top-k/top-p can't move the argmax, so
+        # the processed pipeline reduces to argmax over penalty-adjusted
+        # logits — no sorts at all. A rejected draft's residual argmax IS
+        # the global argmax (the draft wasn't it), and so is the bonus.
+        lg = apply_repetition_penalty(
+            logits, seen_stack, sampling.repetition_penalty
+        )
+        am = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B, k+1]
+    else:
+        vals, idx = _processed_top(
+            logits.reshape(b * k1, v), seen_stack.reshape(b * k1, v),
+            sampling,
+        )
+        vals = vals.reshape(b, k1, -1)
+        idx = idx.reshape(b, k1, -1)
+
+    emitted = jnp.full((b, k1), pad_id, jnp.int32)
+    valid = jnp.zeros((b, k1), jnp.bool_)
+    hit_eos = jnp.zeros((b,), jnp.bool_)
+    chain = active_in  # rows whose drafts have all been accepted so far
+
+    for i in range(k1):
+        rng, r_acc, r_res = jax.random.split(rng, 3)
+        if greedy:
+            tok = am[:, i]
+            accept = (drafts[:, i] == tok) if i < k else jnp.zeros(
+                (b,), jnp.bool_
+            )
+        elif i < k:
+            d = drafts[:, i]
+            at = idx[:, i] == d[:, None]  # [B, K] membership of the draft
+            probs = jax.nn.softmax(vals[:, i], axis=-1)
+            p_d = jnp.sum(jnp.where(at, probs, 0.0), axis=-1)
+            accept = jax.random.uniform(r_acc, (b,)) < p_d
+            # Residual for rejected rows: the processed distribution with
+            # the draft removed, renormalized — the exact leftover rule
+            # for a point-mass proposal.
+            res_vals = jnp.where(at, NEG_INF, vals[:, i])
+            choice = jax.random.categorical(r_res, res_vals, axis=-1)
+            resample = jnp.take_along_axis(
+                idx[:, i], choice[:, None], axis=-1
+            )[:, 0]
+            tok = jnp.where(accept, d, resample)
+        else:
+            # Bonus position: all k drafts survived; sample normally.
+            accept = jnp.zeros((b,), jnp.bool_)
+            choice = jax.random.categorical(r_res, vals[:, i], axis=-1)
+            tok = jnp.take_along_axis(
+                idx[:, i], choice[:, None], axis=-1
+            )[:, 0]
+
+        emit = chain  # rows still in the chain emit at window position i
+        emitted = emitted.at[:, i].set(jnp.where(emit, tok, pad_id))
+        valid = valid.at[:, i].set(emit)
+        is_eos = emit & (tok == eos_id)
+        hit_eos = hit_eos | is_eos
+        # A rejection emits its resample and ends the row's window; an
+        # accepted EOS also ends it (nothing follows EOS).
+        chain = emit & accept & ~is_eos
+
+    # The real (not hypothetical) seen update: tokens actually emitted.
+    emit_oh = jax.nn.one_hot(emitted, v, dtype=jnp.bool_) & valid[..., None]
+    seen = seen | jnp.any(emit_oh, axis=1)
+    return emitted, valid, seen, hit_eos
+
+
+def decode_spec(
+    params,
+    state: DecodeState,
+    input_ids: jax.Array,
+    cfg,
+    sampling: SamplingParams,
+    eos_id: int,
+    pad_id: int,
+    model: ModelFamily = registry.GPT2_FAMILY,
+    spec_tokens: int = 4,
+) -> Tuple[GenerateResult, SpecState]:
+    """Speculative continuation of a prefilled DecodeState.
+
+    Same contract as generate.decode (the engine swaps one for the other
+    when `spec_tokens > 0`) plus the prompt `input_ids` [B, t], which
+    seed the lookup transcript. The cache grows once to its high-water
+    width `t + max_new + spec_tokens - 1`: the widest verify window
+    starts at slot t + (max_new-1) - 1 and spans spec_tokens + 1 slots.
+    """
+    k = spec_tokens
+    max_new = sampling.max_new_tokens
+    b, t = input_ids.shape
+    width = t + max_new + k - 1
+
+    prompt_valid = state.kv_mask[:, :t]
+    cache = _grow_cache(state.cache, width)
+    # Per-row slot offsets from here on (rows advance at different rates);
+    # the loop body overwrites length each step, but the carry's type must
+    # be [B] from the start.
+    cache = cache._replace(
+        length=jnp.broadcast_to(cache.length, (b,)).astype(jnp.int32)
+    )
+    kv_mask = jnp.concatenate(
+        [prompt_valid, jnp.ones((b, width - t), jnp.bool_)], axis=1
+    )
+    # Transcript: prompt ids in slots [0, t) (left-padded like the cache),
+    # generated token g at slot t + g. Pad slots never anchor a match
+    # (match_valid below); out[:, 0] from prefill seeds slot t.
+    transcript = jnp.concatenate(
+        [input_ids, jnp.full((b, max_new), pad_id, jnp.int32)], axis=1
+    )
+    transcript = transcript.at[:, t].set(state.out[:, 0])
+
+    spec = SpecState(
+        cache=cache,
+        transcript=transcript,
+        rng=state.rng,
+        out=state.out,
+        seen=state.seen,
+        done=state.done,
+        n=state.lengths,
+        real_lens=state.real_lens,
+        kv_mask=kv_mask,
+        windows=jnp.zeros((), jnp.int32),
+    )
+    w = t + max_new
+    pos_w = jnp.arange(w, dtype=jnp.int32)[None, :]
+    offs = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    prompt_valid_w = jnp.concatenate(
+        [prompt_valid, jnp.zeros((b, max_new), jnp.bool_)], axis=1
+    )
+
+    def cond(s: SpecState):
+        return ~jnp.all(s.done)
+
+    def body(s: SpecState) -> SpecState:
+        # Window base: active rows feed their last emitted token (slot
+        # t+n-1). Done rows idle — clamp their base inside the budget so
+        # the verify window stays in bounds; their rewrites may scramble
+        # their own cache tail, which nothing ever reads (emissions are
+        # masked off and per-row slots never cross rows).
+        base = jnp.minimum(s.n, max_new - 1) - 1
+        last = jnp.take_along_axis(
+            s.transcript, (t + base)[:, None], axis=1
+        )[:, 0]
+        prev = jnp.take_along_axis(
+            s.transcript, jnp.maximum(t + base - 1, 0)[:, None], axis=1
+        )[:, 0]
+        # A slot may anchor a match iff it is filled (real prompt token or
+        # generated) and ALL k continuation slots behind it are filled too:
+        # an anchor near the frontier would propose not-yet-generated pad
+        # slots, which auto-reject and waste the window (measured: periodic
+        # text sat at ~2 tokens/window because argmax preferred the most
+        # recent — frontier-adjacent — anchor over the one-period-back
+        # anchor whose continuation is actually known).
+        filled = jnp.where(
+            pos_w < t, prompt_valid_w, pos_w < (t + s.n)[:, None]
+        )
+        match_valid = filled & (pos_w <= (t + s.n - 1 - k)[:, None])
+        drafts = build_drafts(s.transcript, match_valid, prev, last, k)
+
+        feed = jnp.concatenate([last[:, None], drafts], axis=1)  # [B, k+1]
+        positions = s.real_lens[:, None] + base[:, None] + offs
+        # Clamp: done rows re-verify their final window forever (writes
+        # are idempotent — same tokens, same slots); the position table
+        # must not overflow while they idle.
+        positions = jnp.minimum(positions, cfg.max_position_embeddings - 1)
+        logits, cache2 = model.forward(
+            params, cfg, feed,
+            cache=s.cache._replace(length=t + base),
+            positions=positions, kv_mask=s.kv_mask,
+        )
+        rng, r_win = jax.random.split(s.rng)
+        emitted, valid, seen, hit_eos = verify_window(
+            r_win, logits, drafts, s.seen, ~s.done, sampling, eos_id, pad_id
+        )
+        # Budget clamp, then scatter: invalid window positions are routed
+        # to an out-of-bounds index and dropped (mode="drop"), so only
+        # genuinely emitted tokens land in out/transcript.
+        slots = s.n[:, None] + offs  # [B, k+1] output indices
+        valid = valid & (slots < max_new)
+        rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+        out = s.out.at[
+            rows, jnp.where(valid, slots, max_new)
+        ].set(emitted, mode="drop")
+        tr = s.transcript.at[
+            rows, jnp.where(valid, t + slots, w)
+        ].set(emitted, mode="drop")
+        n = s.n + jnp.sum(valid, axis=1).astype(jnp.int32)
+        done = s.done | hit_eos | (n >= max_new)
+        return SpecState(
+            cache=cache2, transcript=tr, rng=rng, out=out, seen=seen,
+            done=done, n=n, real_lens=s.real_lens, kv_mask=s.kv_mask,
+            windows=s.windows + 1,
+        )
+
+    spec = jax.lax.while_loop(cond, body, spec)
+    return GenerateResult(tokens=spec.out, lengths=spec.n), spec

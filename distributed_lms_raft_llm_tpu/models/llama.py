@@ -164,8 +164,6 @@ def forward(
     default_positions = positions is None
 
     offset = jnp.zeros((), jnp.int32) if cache is None else cache.length
-    if offset.ndim == 1 and t != 1:
-        raise ValueError("per-row cache offsets support single-token steps only")
     off_row = offset[:, None] if offset.ndim else offset[None, None]
     q_slots = off_row + jnp.arange(t, dtype=jnp.int32)[None, :]
     q_slots = jnp.broadcast_to(q_slots, (b, t))
@@ -257,13 +255,26 @@ def forward(
                 else:
                     k_w, v_w = k_new.astype(ck.dtype), v_new.astype(cv.dtype)
                 cks2, cvs2 = cks, cvs
-                if offset.ndim == 1:  # ragged slots: scatter at per-row pos
-                    rows = jnp.arange(k_new.shape[0])
-                    ck2 = ck.at[layer, rows, :, offset, :].set(k_w[:, :, 0, :])
-                    cv2 = cv.at[layer, rows, :, offset, :].set(v_w[:, :, 0, :])
+                if offset.ndim == 1:
+                    # Ragged slots: scatter each row's T new tokens at its
+                    # own offset (T=1 for paged decode; T=k+1 for the
+                    # speculative verify window — engine.spec). Same layout
+                    # as gpt2.forward.
+                    rows = jnp.arange(k_new.shape[0])[:, None]
+                    slots = offset[:, None] + jnp.arange(t)[None, :]
+                    ck2 = ck.at[layer, rows, :, slots, :].set(
+                        k_w.transpose(0, 2, 1, 3)
+                    )
+                    cv2 = cv.at[layer, rows, :, slots, :].set(
+                        v_w.transpose(0, 2, 1, 3)
+                    )
                     if quant_kv:
-                        cks2 = cks.at[layer, rows, :, offset].set(k_s[:, :, 0])
-                        cvs2 = cvs.at[layer, rows, :, offset].set(v_s[:, :, 0])
+                        cks2 = cks.at[layer, rows, :, slots].set(
+                            k_s.transpose(0, 2, 1)
+                        )
+                        cvs2 = cvs.at[layer, rows, :, slots].set(
+                            v_s.transpose(0, 2, 1)
+                        )
                 else:
                     start = (layer, zero, zero, offset, zero)
                     ck2 = jax.lax.dynamic_update_slice(ck, k_w[None], start)

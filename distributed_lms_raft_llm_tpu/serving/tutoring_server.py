@@ -144,7 +144,7 @@ async def serve_async(
         )
         bound = await server._health.start()
         log.info("health/metrics endpoint on http://127.0.0.1:%d", bound)
-    log.info("tutoring server listening on %d", port)
+    log.info("tutoring server listening on %d", server._port)
     return server
 
 
@@ -173,6 +173,12 @@ def main(argv=None) -> None:
         "--approx-topk", action="store_true",
         help="approximate top-k sampling (~0.95 recall, +12%% decode "
         "throughput); default is bit-exact HF semantics",
+    )
+    parser.add_argument(
+        "--spec-tokens", type=int, default=0,
+        help="speculative decoding: verify this many prompt-lookup draft "
+        "tokens per step (engine/spec.py; exact — the output distribution "
+        "is unchanged). Best for low-batch latency serving; 0 = off",
     )
     parser.add_argument("--max-new-tokens", type=int, default=128)
     parser.add_argument("--max-batch", type=int, default=8)
@@ -218,6 +224,7 @@ def main(argv=None) -> None:
             # marks them explicit, so the file fills only absent ones.
             "kv_quant": t.kv_quant, "paged": t.paged,
             "approx_topk": s.approx_top_k,
+            "spec_tokens": t.spec_tokens,
         }, argv=argv)
         args.sampling_overrides = dict(
             temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
@@ -255,8 +262,13 @@ def main(argv=None) -> None:
         tp=args.tp,
         quant=args.quant,
         kv_quant=args.kv_quant,
+        spec_tokens=args.spec_tokens,
     )
     if args.paged:
+        if args.spec_tokens:
+            parser.error("--spec-tokens applies to the group-batched "
+                         "engine; the paged engine decodes chunked "
+                         "single-token steps")
         # --max-batch bounds concurrency in both modes: it is the decode
         # slot count here (unless --slots overrides it explicitly).
         engine = PagedEngine(config, slots=args.slots or args.max_batch,
