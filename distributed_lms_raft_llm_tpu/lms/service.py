@@ -34,7 +34,7 @@ from typing import Dict, Optional
 import grpc
 
 from ..proto import lms_pb2, rpc
-from ..raft import NotLeader, encode_command
+from ..raft import NotLeader, TransferInFlight, encode_command
 from ..utils import pdf
 from ..utils.auth import sign_query
 from ..utils.metrics import Metrics
@@ -101,7 +101,7 @@ class LMSServicer(rpc.LMSServicer):
         try:
             await self.node.propose(encode_command(op, args))
             return True
-        except (NotLeader, TimeoutError, RuntimeError) as e:
+        except (NotLeader, TransferInFlight, TimeoutError, RuntimeError) as e:
             log.info("propose %s failed: %s", op, e)
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,
@@ -122,7 +122,7 @@ class LMSServicer(rpc.LMSServicer):
             return
         try:
             await self.node.read_barrier()
-        except (NotLeader, TimeoutError, RuntimeError) as e:
+        except (NotLeader, TransferInFlight, TimeoutError, RuntimeError) as e:
             log.info("read fence failed: %s", e)
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,

@@ -1,6 +1,13 @@
 """Raft consensus: sans-IO core, durable storage, asyncio node, transports."""
 
-from .core import NotLeader, RaftConfig, RaftCore, Role  # noqa: F401
+from .core import (  # noqa: F401
+    ConfigChangeInFlight,
+    NotLeader,
+    RaftConfig,
+    RaftCore,
+    Role,
+    TransferInFlight,
+)
 from .messages import (  # noqa: F401
     AppendRequest,
     AppendResponse,

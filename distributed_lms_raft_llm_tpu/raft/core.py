@@ -32,6 +32,8 @@ from .messages import (
     Entry,
     InstallSnapshotRequest,
     InstallSnapshotResponse,
+    TimeoutNowRequest,
+    TimeoutNowResponse,
     VoteRequest,
     VoteResponse,
     decode_membership,
@@ -151,6 +153,19 @@ class RaftCore:
         self._leader_contact = float("-inf")
         # peer -> time the last InstallSnapshot was dispatched (throttle).
         self._snapshot_sent_at: Dict[int, float] = {}
+        # Leadership transfer in flight (thesis §3.10): while set, the
+        # leader refuses new proposals, streams the target up to date, and
+        # fires TimeoutNow once match catches the log head. Cleared on
+        # step-down or deadline expiry.
+        self.transfer_target: Optional[int] = None
+        self._transfer_deadline = 0.0
+        self._timeout_now_sent = False
+        # Target side: while a transfer campaign is live, equal-term
+        # appends from the abdicating leader must not demote the candidate
+        # (the pre-vote mechanism keeps current_term at the OLD term until
+        # the first grant, so the old leader's in-flight heartbeats would
+        # otherwise cancel the sanctioned campaign).
+        self._transfer_campaign_deadline = float("-inf")
 
         # (peer_id, message) pairs for the runner to deliver.
         self.outbox: List[Tuple[int, object]] = []
@@ -249,6 +264,8 @@ class RaftCore:
         a further change is rejected until this one commits."""
         if self.role is not Role.LEADER:
             raise NotLeader(self.leader_id)
+        if self.transfer_target is not None:
+            raise TransferInFlight(self.transfer_target)
         # Safety precondition (Ongaro's 2015 single-server-change bug
         # note): the leader must have COMMITTED an entry of its own term
         # (the election no-op barrier) before appending a config change —
@@ -294,13 +311,21 @@ class RaftCore:
         """Advance timers: elections for followers/candidates, heartbeats
         for leaders."""
         if self.role is Role.LEADER:
+            if (
+                self.transfer_target is not None
+                and now >= self._transfer_deadline
+            ):
+                # The target never took over (died, partitioned, lost the
+                # election): abort and resume normal service (§3.10).
+                self.transfer_target = None
+                self._timeout_now_sent = False
             if now - self._last_heartbeat_sent >= self.config.heartbeat_interval:
                 self.broadcast_append(now)
         elif now >= self.election_deadline:
             if not self.removed:  # a removed server never disrupts the rest
                 self.start_election(now)
 
-    def start_election(self, now: float) -> None:
+    def start_election(self, now: float, transfer: bool = False) -> None:
         """Campaign with a PROPOSED term = current + 1 that is adopted
         (persisted, self-voted) only once a voter acknowledges it — the
         wire-compatible equivalent of pre-vote on the frozen RequestVote
@@ -308,17 +333,26 @@ class RaftCore:
         lease guard below: a removed server, a node campaigning before its
         AddServer lands, a partitioned node) therefore NEVER inflates its
         own term, so when the leader later contacts it their terms match
-        and no step-down/re-election storm follows."""
+        and no step-down/re-election storm follows.
+
+        `transfer` marks a leadership-transfer election (TimeoutNow): the
+        vote requests carry the flag that bypasses voters' leader-lease
+        guard, since this election is sanctioned by the current leader."""
         self.role = Role.CANDIDATE
         self._proposed_term = self.current_term + 1
         self.leader_id = None
         self.votes = {self.node_id}
+        self._transfer_campaign_deadline = (
+            now + self.config.election_timeout_min if transfer
+            else float("-inf")
+        )
         self._reset_election_timer(now)
         req = VoteRequest(
             term=self._proposed_term,
             candidate_id=self.node_id,
             last_log_index=self.last_log_index,
             last_log_term=self.last_log_term,
+            transfer=transfer,
         )
         for peer in self.peer_ids:
             self.outbox.append((peer, req))
@@ -352,6 +386,8 @@ class RaftCore:
             self._persist_meta()
         self.role = Role.FOLLOWER
         self.votes = set()
+        self.transfer_target = None
+        self._timeout_now_sent = False
         self._reset_election_timer(now)
 
     # Vote handling -------------------------------------------------------
@@ -366,7 +402,10 @@ class RaftCore:
         # with ever-higher terms. Crucially the term is NOT adopted here;
         # a genuinely deposed leader still steps down via the higher term
         # on append/vote RESPONSES or a new leader's appends.
-        if (
+        # A transfer election is leader-sanctioned — the lease guard's
+        # purpose (stopping disruptive elections) doesn't apply, and the
+        # current leader itself must process it to be deposed promptly.
+        if not req.transfer and (
             self.role is Role.LEADER
             or now - self._leader_contact < self.config.election_timeout_min
         ):
@@ -470,6 +509,12 @@ class RaftCore:
     def broadcast_append(self, now: float) -> None:
         self._last_heartbeat_sent = now
         for peer in self.peer_ids:
+            if peer == self.transfer_target and self._timeout_now_sent:
+                # The target is campaigning at our sanction; our own
+                # heartbeats arriving at its (still equal) term would
+                # demote it mid-campaign. Go quiet until the transfer
+                # resolves (step-down here, or deadline abort).
+                continue
             msg = self.append_request_for(peer, now)
             if msg is not None:
                 self.outbox.append((peer, msg))
@@ -479,6 +524,20 @@ class RaftCore:
             self._step_down(req.term, now)
         if req.term < self.current_term:
             return AppendResponse(term=self.current_term, success=False)
+        if (
+            self.role is Role.CANDIDATE
+            and now < self._transfer_campaign_deadline
+        ):
+            # Transfer campaign in progress: the equal-term append is the
+            # ABDICATING leader's in-flight traffic — don't let it cancel
+            # the campaign it sanctioned. Reject without demoting; the old
+            # leader steps down on seeing our proposed term, and if the
+            # campaign fails the election timer recovers normally.
+            return AppendResponse(
+                term=self.current_term,
+                success=False,
+                conflict_index=self.last_log_index + 1,
+            )
         # Valid leader for this term.
         if self.role is not Role.FOLLOWER:
             self._step_down(req.term, now)
@@ -559,6 +618,7 @@ class RaftCore:
                 self.match_index[peer] = resp.match_index
             self.next_index[peer] = self.match_index[peer] + 1
             self._advance_commit()
+            self._maybe_fire_timeout_now(now)
             # Keep streaming if the peer is still behind — otherwise catch-up
             # would be paced at max_entries_per_append per heartbeat.
             if self.next_index[peer] <= self.last_log_index:
@@ -587,12 +647,79 @@ class RaftCore:
                 self.commit_index = index
                 break
 
+    # Leadership transfer (thesis §3.10) ----------------------------------
+
+    def transfer_leadership(
+        self, now: float, target: Optional[int] = None
+    ) -> int:
+        """Leader-only: hand leadership to `target` (default: the most
+        caught-up member). New proposals are refused while the transfer is
+        in flight (so the target can actually catch the log head); once
+        the target's match_index reaches our last index it receives
+        TimeoutNow and campaigns immediately — its vote requests bypass
+        the leader-lease guard, and this leader steps down on seeing the
+        higher term. If nothing happens within an election timeout the
+        transfer aborts and normal service resumes."""
+        if self.role is not Role.LEADER:
+            raise NotLeader(self.leader_id)
+        if self.transfer_target is not None:
+            # One transfer at a time: overwriting the target could fire a
+            # second TimeoutNow and split the transfer vote between two
+            # lease-bypassing candidates.
+            raise TransferInFlight(self.transfer_target)
+        candidates = [p for p in self.peer_ids if p in self.members]
+        if not candidates:
+            raise ValueError("no other member to transfer leadership to")
+        if target is None:
+            target = max(candidates, key=lambda p: self.match_index.get(p, 0))
+        if target == self.node_id or target not in self.members:
+            raise ValueError(f"target {target} is not another cluster member")
+        self.transfer_target = target
+        self._transfer_deadline = now + self.config.election_timeout_max
+        self._timeout_now_sent = False
+        self._maybe_fire_timeout_now(now)
+        if not self._timeout_now_sent:
+            self.broadcast_append(now)  # stream the target up to date
+        return target
+
+    def _maybe_fire_timeout_now(self, now: float) -> None:
+        t = self.transfer_target
+        if (
+            t is None
+            or self._timeout_now_sent
+            or self.role is not Role.LEADER
+            or self.match_index.get(t, 0) < self.last_log_index
+        ):
+            return
+        self._timeout_now_sent = True
+        self.outbox.append(
+            (t, TimeoutNowRequest(term=self.current_term,
+                                  leader_id=self.node_id))
+        )
+
+    def on_timeout_now(
+        self, req: TimeoutNowRequest, now: float
+    ) -> TimeoutNowResponse:
+        """The leader chose this node as its successor: campaign NOW."""
+        if req.term >= self.current_term and not self.removed:
+            self.leader_id = None
+            self.start_election(now, transfer=True)
+        return TimeoutNowResponse(term=self.current_term)
+
+    def on_timeout_now_response(
+        self, resp: TimeoutNowResponse, now: float
+    ) -> None:
+        if resp.term > self.current_term:
+            self._step_down(resp.term, now)
+
     # Client-facing -------------------------------------------------------
 
     def propose(self, command: str, now: float) -> int:
         """Leader-only: append a command; returns its log index."""
         if self.role is not Role.LEADER:
             raise NotLeader(self.leader_id)
+        if self.transfer_target is not None:
+            raise TransferInFlight(self.transfer_target)
         self.log.append(Entry(term=self.current_term, command=command))
         self.storage.append_entries(self.last_log_index, self.log[-1:])
         self._advance_commit()  # single-node clusters commit instantly
@@ -740,6 +867,18 @@ class NotLeader(Exception):
     def __init__(self, leader_id: Optional[int]):
         super().__init__(f"not the leader (known leader: {leader_id})")
         self.leader_id = leader_id
+
+
+class TransferInFlight(Exception):
+    """Raised for proposals while a leadership transfer is in progress —
+    retryable: the transfer either completes (retry reaches the new
+    leader via NotLeader redirect) or aborts within an election timeout."""
+
+    def __init__(self, target: int):
+        super().__init__(
+            f"leadership transfer to node {target} in progress; retry"
+        )
+        self.target = target
 
 
 class ConfigChangeInFlight(Exception):

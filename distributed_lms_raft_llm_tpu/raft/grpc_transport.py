@@ -33,6 +33,8 @@ from .messages import (
     Entry,
     InstallSnapshotRequest,
     InstallSnapshotResponse,
+    TimeoutNowRequest,
+    TimeoutNowResponse,
     VoteRequest,
     VoteResponse,
 )
@@ -49,6 +51,7 @@ def vote_request_to_wire(req: VoteRequest) -> lms_pb2.RequestVoteRequest:
         candidate=lms_pb2.TermCandIDPair(term=req.term, candidateID=req.candidate_id),
         lastLogIndex=req.last_log_index,
         lastLogTerm=req.last_log_term,
+        transfer=req.transfer,
     )
 
 
@@ -58,6 +61,7 @@ def vote_request_from_wire(msg: lms_pb2.RequestVoteRequest) -> VoteRequest:
         candidate_id=msg.candidate.candidateID,
         last_log_index=msg.lastLogIndex,
         last_log_term=msg.lastLogTerm,
+        transfer=msg.transfer,
     )
 
 
@@ -178,6 +182,14 @@ class GrpcTransport(Transport):
                 install_request_to_wire(message), timeout=self.rpc_timeout
             )
             return InstallSnapshotResponse(term=wire.term, success=wire.success)
+        if isinstance(message, TimeoutNowRequest):
+            wire = await stub.TimeoutNow(
+                lms_pb2.TimeoutNowRequest(
+                    term=message.term, leaderID=message.leader_id
+                ),
+                timeout=self.rpc_timeout,
+            )
+            return TimeoutNowResponse(term=wire.term)
         raise TypeError(type(message))
 
     async def close(self) -> None:
@@ -216,6 +228,12 @@ class RaftServicer(rpc.RaftServiceServicer):
         return lms_pb2.InstallSnapshotResponse(
             term=resp.term, success=resp.success
         )
+
+    async def TimeoutNow(self, request, context):
+        resp = self.node.handle_timeout_now(
+            TimeoutNowRequest(term=request.term, leader_id=request.leaderID)
+        )
+        return lms_pb2.TimeoutNowResponse(term=resp.term)
 
     async def WhoIsLeader(self, request, context):
         leader = self.node.leader_id

@@ -31,6 +31,11 @@ class VoteRequest:
     candidate_id: int
     last_log_index: int
     last_log_term: int
+    # Set on the election a leadership transfer triggers (TimeoutNow):
+    # voters process it even inside their leader-lease window (thesis
+    # §4.2.3 carve-out — the lease exists to stop DISRUPTIVE elections;
+    # a transfer election is leader-sanctioned).
+    transfer: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +82,22 @@ class InstallSnapshotRequest:
 class InstallSnapshotResponse:
     term: int
     success: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeoutNowRequest:
+    """Leader → chosen successor: campaign immediately (leadership
+    transfer, Raft thesis §3.10). Sent only once the target's match_index
+    has reached the leader's last log index, so the §5.4.1 up-to-date vote
+    check cannot reject it."""
+
+    term: int
+    leader_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeoutNowResponse:
+    term: int
 
 
 def encode_command(op: str, args: Optional[Dict[str, Any]] = None) -> str:

@@ -32,6 +32,8 @@ from .messages import (
     Entry,
     InstallSnapshotRequest,
     InstallSnapshotResponse,
+    TimeoutNowRequest,
+    TimeoutNowResponse,
     VoteRequest,
     VoteResponse,
     is_membership,
@@ -148,6 +150,27 @@ class RaftNode:
         except asyncio.TimeoutError:
             raise TimeoutError(f"entry {index} not committed within {timeout}s")
 
+    async def transfer_leadership(
+        self, target: Optional[int] = None, timeout: float = 5.0
+    ) -> int:
+        """Hand leadership to `target` (default: most caught-up member) and
+        wait until this node has actually stepped down (or the transfer
+        aborted and we are still leader — then raises TimeoutError).
+        Returns the target node id."""
+        chosen = self.core.transfer_leadership(time.monotonic(), target)
+        self._pump()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.core.role is not Role.LEADER:
+                return chosen
+            if self.core.transfer_target is None:
+                # Aborted (target unreachable / lost): surface it.
+                raise TimeoutError(
+                    f"leadership transfer to {chosen} aborted; still leader"
+                )
+            await asyncio.sleep(self.tick_interval)
+        raise TimeoutError(f"leadership transfer to {chosen} timed out")
+
     async def read_barrier(self, timeout: float = 10.0) -> int:
         """Linearizable read fence: resolves once this node has PROVEN it is
         still the leader by committing an entry of its current term, with the
@@ -182,6 +205,11 @@ class RaftNode:
 
     def handle_append_request(self, req: AppendRequest) -> AppendResponse:
         resp = self.core.on_append_request(req, time.monotonic())
+        self._pump()
+        return resp
+
+    def handle_timeout_now(self, req: TimeoutNowRequest) -> TimeoutNowResponse:
+        resp = self.core.on_timeout_now(req, time.monotonic())
         self._pump()
         return resp
 
@@ -279,6 +307,10 @@ class RaftNode:
             resp, InstallSnapshotResponse
         ):
             self.core.on_install_snapshot_response(peer, message, resp, now)
+        elif isinstance(message, TimeoutNowRequest) and isinstance(
+            resp, TimeoutNowResponse
+        ):
+            self.core.on_timeout_now_response(resp, now)
         self._pump()
 
     def _discard_task(self, task: asyncio.Task) -> None:
@@ -364,6 +396,8 @@ class MemNetwork:
             resp = node.handle_append_request(message)
         elif isinstance(message, InstallSnapshotRequest):
             resp = node.handle_install_snapshot(message)
+        elif isinstance(message, TimeoutNowRequest):
+            resp = node.handle_timeout_now(message)
         else:
             raise TypeError(type(message))
         if self._blocked(dst, src):

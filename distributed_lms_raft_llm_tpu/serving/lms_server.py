@@ -114,8 +114,19 @@ async def serve_async(args) -> None:
     async def admin(path: str, body: Dict) -> Dict:
         """POST /admin/membership {"op": "add"|"remove", "id": N,
         "address": "host:port"} — single-server Raft membership change on
-        the leader (raft/core.py §4 machinery). The admin plane rides the
-        local HTTP endpoint, keeping the gRPC wire contract frozen."""
+        the leader (raft/core.py §4 machinery).
+        POST /admin/transfer {"target": N?} — graceful leadership handoff
+        (thesis §3.10: drain to the most caught-up member before planned
+        maintenance; resolves once this node has stepped down).
+        The admin plane rides the local HTTP endpoint, keeping the gRPC
+        wire contract frozen."""
+        if path == "/admin/transfer":
+            target = body.get("target")
+            chosen = await lms_node.node.transfer_leadership(
+                None if target is None else int(target)
+            )
+            return {"ok": True, "target": chosen,
+                    "leader_id": lms_node.node.leader_id}
         if path != "/admin/membership":
             raise KeyError(path)
         op = body.get("op")

@@ -60,7 +60,7 @@ def test_frozen_contract_method_names():
     assert raft_methods - {
         "RequestVote", "AppendEntries", "SetVal", "GetVal", "GetLeader",
         "WhoIsLeader",
-    } == {"InstallSnapshot"}
+    } == {"InstallSnapshot", "TimeoutNow"}
     ft_methods = {m.name for m in services["FileTransferService"].methods}
     assert ft_methods >= {"SendFile", "ReplicateData"}
     assert ft_methods - {"SendFile", "ReplicateData"} == {"FetchFile"}

@@ -1,0 +1,346 @@
+"""Leadership transfer (Raft thesis §3.10).
+
+The reference can only change leaders by crashing one and waiting out a
+randomized election timeout (reference: GUI_RAFT_LLM_SourceCode/
+lms_server.py:1539-1547 — 10-30 s of unavailability). Here the leader
+hands off deliberately: it picks the most caught-up member, refuses new
+proposals while the target catches the log head, sends TimeoutNow, and
+the target campaigns immediately — its vote requests carry the additive
+`transfer` flag that bypasses voters' leader-lease guard, so the handoff
+completes in one round trip instead of an election timeout. Planned
+maintenance (drain-then-restart) becomes a sub-second blip.
+"""
+
+import asyncio
+
+import pytest
+
+from distributed_lms_raft_llm_tpu.raft import (
+    MemNetwork,
+    MemoryStorage,
+    RaftConfig,
+    RaftNode,
+    TransferInFlight,
+    encode_command,
+)
+from distributed_lms_raft_llm_tpu.raft.core import NotLeader, RaftCore, Role
+from distributed_lms_raft_llm_tpu.raft.messages import (
+    Entry,
+    TimeoutNowRequest,
+    VoteRequest,
+)
+
+from test_raft_cluster import FAST, build_cluster, wait_for_leader
+
+
+async def wait_until(cond, timeout=5.0, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------------------ core semantics
+
+
+def _leader_core(n_peers=2) -> RaftCore:
+    core = RaftCore(1, list(range(1, n_peers + 2)), MemoryStorage(),
+                    RaftConfig(), now=0.0, seed=7)
+    core.current_term = 2
+    core.role = Role.LEADER
+    core.leader_id = 1
+    core.next_index = {p: 1 for p in core.peer_ids}
+    core.match_index = {p: 0 for p in core.peer_ids}
+    # An entry of the current term, fully replicated (through storage so
+    # the WAL mirror stays consistent with core.log).
+    core.log.append(Entry(term=2, command="x"))
+    core.storage.append_entries(1, core.log[-1:])
+    for p in core.peer_ids:
+        core.match_index[p] = core.last_log_index
+        core.next_index[p] = core.last_log_index + 1
+    core.commit_index = core.last_log_index
+    core.drain_outbox()
+    return core
+
+
+class TestCore:
+    def test_fires_timeout_now_when_target_caught_up(self):
+        core = _leader_core()
+        target = core.transfer_leadership(1.0, target=2)
+        assert target == 2
+        sent = [(p, m) for p, m in core.drain_outbox()
+                if isinstance(m, TimeoutNowRequest)]
+        assert sent == [(2, TimeoutNowRequest(term=2, leader_id=1))]
+
+    def test_auto_target_is_most_caught_up(self):
+        core = _leader_core()
+        core.match_index[3] = core.last_log_index
+        core.match_index[2] = 0  # lagging
+        assert core.transfer_leadership(1.0) == 3
+
+    def test_waits_for_lagging_target_then_fires(self):
+        core = _leader_core()
+        core.match_index[2] = 0  # target behind
+        core.transfer_leadership(1.0, target=2)
+        assert not any(isinstance(m, TimeoutNowRequest)
+                       for _, m in core.drain_outbox())
+        # Catch-up ack arrives -> TimeoutNow fires exactly once.
+        from distributed_lms_raft_llm_tpu.raft.messages import AppendResponse
+
+        core.on_append_response(2, AppendResponse(
+            term=2, success=True, match_index=core.last_log_index), 1.1)
+        fired = [m for _, m in core.drain_outbox()
+                 if isinstance(m, TimeoutNowRequest)]
+        assert len(fired) == 1
+        core.on_append_response(2, AppendResponse(
+            term=2, success=True, match_index=core.last_log_index), 1.2)
+        assert not any(isinstance(m, TimeoutNowRequest)
+                       for _, m in core.drain_outbox())
+
+    def test_proposals_refused_during_transfer(self):
+        core = _leader_core()
+        core.transfer_leadership(1.0, target=2)
+        with pytest.raises(TransferInFlight):
+            core.propose("nope", 1.1)
+        with pytest.raises(TransferInFlight):
+            core.propose_config({1: "", 2: ""}, 1.1)
+
+    def test_transfer_aborts_at_deadline(self):
+        core = _leader_core()
+        core.transfer_leadership(1.0, target=2)
+        core.tick(1.0 + core.config.election_timeout_max + 0.01)
+        assert core.transfer_target is None
+        core.propose("resumed", 2.0)  # accepted again
+
+    def test_transfer_requires_leadership_and_valid_target(self):
+        core = _leader_core()
+        with pytest.raises(ValueError):
+            core.transfer_leadership(1.0, target=1)  # self
+        with pytest.raises(ValueError):
+            core.transfer_leadership(1.0, target=99)  # not a member
+        core.role = Role.FOLLOWER
+        with pytest.raises(NotLeader):
+            core.transfer_leadership(1.0)
+
+    def test_transfer_vote_bypasses_leader_lease(self):
+        # A follower freshly contacted by its leader disregards normal
+        # vote requests (§4.2.3) but must process a transfer election.
+        core = RaftCore(2, [1, 2, 3], MemoryStorage(), RaftConfig(),
+                        now=0.0, seed=8)
+        core.current_term = 2
+        core._leader_contact = 10.0  # just heard from leader 1
+        plain = VoteRequest(term=3, candidate_id=3, last_log_index=0,
+                            last_log_term=0)
+        assert not core.on_vote_request(plain, 10.01).granted
+        xfer = VoteRequest(term=3, candidate_id=3, last_log_index=0,
+                           last_log_term=0, transfer=True)
+        assert core.on_vote_request(xfer, 10.02).granted
+
+    def test_timeout_now_starts_immediate_campaign(self):
+        core = RaftCore(2, [1, 2, 3], MemoryStorage(), RaftConfig(),
+                        now=0.0, seed=9)
+        core.current_term = 2
+        core._leader_contact = 10.0
+        core.on_timeout_now(TimeoutNowRequest(term=2, leader_id=1), 10.01)
+        assert core.role is Role.CANDIDATE
+        votes = [m for _, m in core.drain_outbox()
+                 if isinstance(m, VoteRequest)]
+        assert votes and all(v.transfer and v.term == 3 for v in votes)
+
+    def test_second_transfer_refused_while_in_flight(self):
+        core = _leader_core()
+        core.transfer_leadership(1.0, target=2)
+        with pytest.raises(TransferInFlight):
+            core.transfer_leadership(1.1, target=3)
+
+    def test_equal_term_heartbeat_does_not_cancel_campaign(self):
+        # The abdicating leader's in-flight appends arrive at the target's
+        # still-equal term mid-campaign; they must not demote it.
+        from distributed_lms_raft_llm_tpu.raft.messages import (
+            AppendRequest,
+            VoteResponse,
+        )
+
+        core = RaftCore(2, [1, 2, 3], MemoryStorage(), RaftConfig(),
+                        now=0.0, seed=11)
+        core.current_term = 2
+        core.on_timeout_now(TimeoutNowRequest(term=2, leader_id=1), 10.0)
+        assert core.role is Role.CANDIDATE
+        hb = AppendRequest(term=2, leader_id=1, prev_log_index=0,
+                           prev_log_term=0, entries=(), leader_commit=0)
+        resp = core.on_append_request(hb, 10.01)
+        assert not resp.success
+        assert core.role is Role.CANDIDATE  # campaign survives
+        core.drain_outbox()
+        core.on_vote_response(3, VoteResponse(term=3, granted=True), 10.02)
+        assert core.role is Role.LEADER
+
+    def test_leader_goes_quiet_to_target_after_timeout_now(self):
+        core = _leader_core()
+        core.transfer_leadership(1.0, target=2)
+        core.drain_outbox()
+        core.tick(1.0 + core.config.heartbeat_interval + 0.001)
+        dests = {p for p, _ in core.drain_outbox()}
+        assert 2 not in dests and 3 in dests
+
+    def test_stale_timeout_now_ignored(self):
+        core = RaftCore(2, [1, 2, 3], MemoryStorage(), RaftConfig(),
+                        now=0.0, seed=10)
+        core.current_term = 5
+        core.on_timeout_now(TimeoutNowRequest(term=2, leader_id=1), 1.0)
+        assert core.role is Role.FOLLOWER
+
+
+# --------------------------------------------------------- cluster behavior
+
+
+def test_mem_cluster_graceful_handoff():
+    """Full handoff on a 3-node cluster: sub-election-timeout, no lost
+    committed writes, old leader steps down, new leader serves."""
+
+    async def run():
+        net = MemNetwork()
+        applied = {}
+        nodes, _ = build_cluster(net, 3, applied=applied)
+        for n in nodes.values():
+            await n.start()
+        leader = await wait_for_leader(nodes)
+        for k in range(5):
+            await leader.propose(encode_command("set", {"k": str(k)}))
+
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        target = await leader.transfer_leadership()
+        took = loop.time() - t0
+        assert not leader.is_leader
+        await wait_until(lambda: nodes[target].is_leader, what="target leads")
+        # Well under the minimum election timeout: the whole point.
+        assert took < FAST.election_timeout_min, took
+
+        # The new leader serves writes; nothing committed was lost.
+        await nodes[target].propose(encode_command("set", {"k": "after"}))
+        await wait_until(
+            lambda: all(
+                any('"after"' in cmd for _, cmd in applied.get(i, []))
+                for i in nodes
+            ),
+            what="post-transfer write applied everywhere",
+        )
+        seen = [cmd for _, cmd in applied[target]]
+        assert len([c for c in seen if '"k"' in c]) == 6
+
+        for n in nodes.values():
+            await n.stop()
+
+    asyncio.run(run())
+
+
+def test_mem_cluster_transfer_to_explicit_lagging_target():
+    """A lagging explicit target is streamed up to date first, then takes
+    over — the §3.10 prior-catch-up step."""
+
+    async def run():
+        net = MemNetwork()
+        nodes, _ = build_cluster(net, 3, applied={})
+        for n in nodes.values():
+            await n.start()
+        leader = await wait_for_leader(nodes)
+        others = [i for i in nodes if i != leader.node_id]
+        lag = others[0]
+        # Cut the target off, commit writes through the remaining quorum.
+        net.drop_pairs = {(leader.node_id, lag), (lag, leader.node_id)}
+        for k in range(4):
+            await leader.propose(encode_command("set", {"k": str(k)}))
+        assert nodes[lag].core.last_log_index < leader.core.last_log_index
+        net.heal()
+
+        target = await leader.transfer_leadership(lag)
+        assert target == lag
+        await wait_until(lambda: nodes[lag].is_leader, what="laggard leads")
+        # Leader completeness: it caught up before campaigning.
+        assert nodes[lag].core.last_log_index >= 5
+
+        for n in nodes.values():
+            await n.stop()
+
+    asyncio.run(run())
+
+
+def test_grpc_cluster_graceful_handoff(tmp_path):
+    """The whole path over real gRPC: TimeoutNow RPC, transfer-flagged
+    RequestVote, step-down, new leader serving SetVal."""
+    import grpc as grpc_mod
+
+    from distributed_lms_raft_llm_tpu.proto import lms_pb2, rpc
+    from distributed_lms_raft_llm_tpu.raft.grpc_transport import (
+        GrpcTransport,
+        RaftServicer,
+    )
+    from distributed_lms_raft_llm_tpu.raft.storage import FileStorage
+
+    async def run():
+        ids = [1, 2, 3]
+        servers, nodes, addresses = {}, {}, {}
+        for i in ids:
+            servers[i] = grpc_mod.aio.server()
+            port = servers[i].add_insecure_port("127.0.0.1:0")
+            addresses[i] = f"127.0.0.1:{port}"
+        for i in ids:
+            storage = FileStorage(str(tmp_path / f"wal{i}.jsonl"),
+                                  fsync=False)
+            node = RaftNode(i, ids, storage, GrpcTransport(addresses),
+                            config=FAST, tick_interval=0.01, seed=i)
+            rpc.add_RaftServiceServicer_to_server(
+                RaftServicer(node, addresses), servers[i]
+            )
+            nodes[i] = node
+            await servers[i].start()
+            await node.start()
+        try:
+            leader = await wait_for_leader(nodes)
+            target = await leader.transfer_leadership()
+            assert not leader.is_leader
+            await wait_until(lambda: nodes[target].is_leader,
+                             what="target leads over gRPC")
+            async with grpc_mod.aio.insecure_channel(
+                addresses[target]
+            ) as ch:
+                stub = rpc.RaftServiceStub(ch)
+                setr = await stub.SetVal(
+                    lms_pb2.SetValRequest(key="k", value="v"), timeout=10
+                )
+                assert setr.verdict
+        finally:
+            for n in nodes.values():
+                await n.stop()
+            for s in servers.values():
+                await s.stop(None)
+
+    asyncio.run(run())
+
+
+def test_mem_cluster_transfer_aborts_when_target_down():
+    async def run():
+        net = MemNetwork()
+        nodes, _ = build_cluster(net, 3, applied={})
+        for n in nodes.values():
+            await n.start()
+        leader = await wait_for_leader(nodes)
+        others = [i for i in nodes if i != leader.node_id]
+        dead = others[0]
+        await nodes[dead].stop()
+        with pytest.raises(TimeoutError):
+            await leader.transfer_leadership(dead, timeout=2.0)
+        # Aborted: still (or again) able to serve.
+        await wait_until(lambda: leader.core.transfer_target is None,
+                         what="transfer aborted")
+        await leader.propose(encode_command("set", {"k": "alive"}))
+
+        for n in nodes.values():
+            if n is not nodes[dead]:
+                await n.stop()
+
+    asyncio.run(run())
