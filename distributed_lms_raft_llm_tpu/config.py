@@ -66,6 +66,7 @@ class TutoringConfig:
     merges: Optional[str] = None
     tokenizer_json: Optional[str] = None
     tp: int = 1
+    ep: int = 1                  # expert-parallel ways (MoE presets)
     quant: Optional[str] = None  # "int8" = weight-only int8
     kv_quant: bool = False
     spec_tokens: int = 0         # speculative decoding draft window (exact)
@@ -201,7 +202,7 @@ def engine_config(cfg: AppConfig):
     return EngineConfig(
         model=t.model, checkpoint=t.checkpoint, vocab_path=t.vocab,
         merges_path=t.merges, tokenizer_json=t.tokenizer_json,
-        sampling=sampling_params(cfg), tp=t.tp, quant=t.quant,
+        sampling=sampling_params(cfg), tp=t.tp, ep=t.ep, quant=t.quant,
         kv_quant=t.kv_quant, spec_tokens=t.spec_tokens,
     )
 

@@ -53,6 +53,10 @@ class EngineConfig:
     length_buckets: Tuple[int, ...] = (32, 64, 128, 256)
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
     tp: int = 1  # tensor-parallel ways; dp absorbs remaining devices
+    # Expert-parallel ways (MoE presets: gpt2-moe / moe-tiny): the expert
+    # stacks shard over the `ep` mesh axis (parallel/partition.py
+    # MOE_RULES). Composes with tp x dp; 1 for dense models.
+    ep: int = 1
     # Fused Pallas decode attention (ops/attention.py). None = off: with the
     # cache's [.., S, 64] head-dim-minor layout the kernel's DMA runs at
     # half-filled 128-lane tiles and measured slightly SLOWER end-to-end
@@ -102,7 +106,27 @@ class TutoringEngine:
         self.family, self.cfg = registry.resolve(
             config.model, config.dtype, config.param_dtype
         )
-        self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1}, devices=devices)
+        if config.ep > 1 and self.family.name != "gpt2_moe":
+            raise ValueError(
+                f"ep={config.ep} requires an MoE family; {config.model!r} "
+                f"has no expert axis to shard — the ep devices would "
+                f"silently replicate (shrinking dp) instead of helping"
+            )
+        if (
+            config.spec_tokens > 0
+            and self.family.name == "gpt2_moe"
+            and self.cfg.capacity_factor < self.cfg.num_experts
+        ):
+            raise ValueError(
+                "spec_tokens with an MoE model requires capacity_factor >= "
+                "num_experts (no token dropping): capacity drops make a "
+                "token's output depend on its forward-pass companions, so "
+                "the speculative verify window would sample from different "
+                "distributions than step decode (models/moe.py caveat)"
+            )
+        self.mesh = mesh_lib.make_mesh(
+            {"tp": config.tp, "ep": config.ep, "dp": -1}, devices=devices
+        )
         if config.fused_attention:
             if self.mesh.devices.size != 1:
                 raise ValueError(

@@ -147,7 +147,9 @@ def init_cache(cfg: GPT2Config, batch: int, max_len: int, dtype=None) -> KVCache
 def apply_block(x, lp, attend_fn, cfg: GPT2Config):
     """One transformer block; `attend_fn(q, k_new, v_new) -> context` owns
     cache handling + attention so every path (dense, ring, cached decode,
-    pipeline stage) shares one copy of the math."""
+    pipeline stage) shares one copy of the math. Blocks whose params carry
+    a `moe` subtree instead of `mlp` route the feed-forward through the
+    expert layer (models/moe.py) — same trunk, cache, and decode paths."""
     eps = cfg.layer_norm_eps
     h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], eps)
     qkv = dense(h, lp["attn"]["wqkv"], lp["attn"]["bqkv"])
@@ -159,6 +161,10 @@ def apply_block(x, lp, attend_fn, cfg: GPT2Config):
     )
     x = x + dense(merge_heads(a), lp["attn"]["wo"], lp["attn"]["bo"])
     h2 = layer_norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"], eps)
+    if "moe" in lp:
+        from . import moe as moe_lib
+
+        return x + moe_lib.moe_mlp(h2, lp["moe"], cfg)
     m = dense(h2, lp["mlp"]["wi"], lp["mlp"]["bi"])
     m = jax.nn.gelu(m, approximate=True)  # GPT-2 uses the tanh approximation
     x = x + dense(m, lp["mlp"]["wo"], lp["mlp"]["bo"])

@@ -1,0 +1,192 @@
+"""Mixture-of-Experts GPT-2 with expert parallelism (the `ep` mesh axis).
+
+Beyond-reference capability (the reference serves dense GPT-2 only —
+GUI_RAFT_LLM_SourceCode/tutoring_server.py:10-12): every transformer
+block's dense MLP becomes E feed-forward experts behind a learned top-k
+router, executed the canonical TPU way (GShard / Switch Transformer):
+
+- **Static-shape dispatch/combine einsums, no gather loops.** Each token's
+  top-k experts and its position within each expert's capacity buffer are
+  computed with one_hot + cumsum (pure static ops), giving a dispatch
+  tensor [S, E, C] and a weight-carrying combine tensor of the same shape.
+  Expert inputs are then one einsum ("sec,sd->ecd"), the expert FFNs are
+  batched matmuls over the leading E axis (MXU-friendly), and outputs
+  come back through the transposed einsum. Tokens over capacity are
+  dropped (combine weight 0) and ride the residual stream — the standard
+  Switch behavior, bounded compute per step by construction.
+- **Expert parallelism = shard the E axis.** Partition rules place
+  `blocks/moe/{wi,bi,wo,bo}` on the `ep` mesh axis
+  (parallel/partition.py); under jit the dispatch einsum's contraction
+  against ep-sharded expert weights makes XLA insert the all-to-all /
+  reduce-scatter collectives itself — no hand-written comm, exactly like
+  the tp rules. Composes with tp/dp on the other axes.
+- **Everything else is the GPT-2 trunk.** `forward` IS gpt2.forward: the
+  block routes through this MLP when its params carry a `moe` subtree, so
+  the KV cache, bucketed prefill, while_loop decode, ragged paged slots,
+  and speculative verification all work unchanged.
+
+Top-k routing follows the Mixtral convention: softmax over all experts,
+keep the k largest, renormalize their weights. `capacity_factor` scales
+the per-expert buffer C = ceil(cf * S * k / E); cf >= E disables dropping
+entirely (C >= S*k: every slot pick fits even if all land on one expert).
+
+Capacity caveat: with dropping active, a token's output depends on what
+else shares its forward pass (whether it wins a buffer slot) — inherent
+to Switch-style capacity, not a bug. Consequences: group-batched serving
+is deterministic per batch but not per request, and speculative decoding
+(engine/spec.py) verifies against window-context distributions that can
+differ from step-context ones, so its exactness guarantee holds for MoE
+only at cf >= E (no drops). Decode-sized forwards (S = batch) rarely
+drop in practice; raise capacity_factor where bit-stability matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import gpt2
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2MoEConfig(gpt2.GPT2Config):
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+
+    @classmethod
+    def moe_small(cls, **kw) -> "GPT2MoEConfig":
+        """GPT-2-small trunk, 8 experts x top-2 (~124M active / ~680M total)."""
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2MoEConfig":
+        kw.setdefault("vocab_size", 384)
+        kw.setdefault("max_position_embeddings", 64)
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("experts_per_token", 2)
+        return cls(hidden_size=32, num_layers=2, num_heads=4, **kw)
+
+
+def init_params(rng: jax.Array, cfg: GPT2MoEConfig) -> Params:
+    """GPT-2 init with each block's `mlp` replaced by a `moe` subtree:
+    router [L, D, E] plus per-expert FFN stacks [L, E, D, M] / [L, E, M, D].
+    """
+    params = gpt2.init_params(rng, cfg)
+    d, l, m, e = (cfg.hidden_size, cfg.num_layers, cfg.mlp_dim,
+                  cfg.num_experts)
+    keys = jax.random.split(jax.random.fold_in(rng, 17), 3)
+    std = 0.02
+    proj_std = std / jnp.sqrt(2.0 * l)
+    pd = cfg.param_dtype
+
+    def norm(key, shape, s):
+        return (s * jax.random.normal(key, shape)).astype(pd)
+
+    params["blocks"].pop("mlp")
+    params["blocks"]["moe"] = {
+        "wr": norm(keys[0], (l, d, e), std),
+        "wi": norm(keys[1], (l, e, d, m), std),
+        "bi": jnp.zeros((l, e, m), pd),
+        "wo": norm(keys[2], (l, e, m, d), proj_std),
+        "bo": jnp.zeros((l, e, d), pd),
+    }
+    return params
+
+
+def capacity(cfg: GPT2MoEConfig, tokens: int) -> int:
+    return max(
+        1,
+        math.ceil(
+            cfg.capacity_factor * tokens * cfg.experts_per_token
+            / cfg.num_experts
+        ),
+    )
+
+
+def moe_mlp(h: jax.Array, mp: Dict[str, jax.Array], cfg) -> jax.Array:
+    """The expert layer: [B, T, D] -> [B, T, D] (residual not included).
+
+    mp holds ONE layer's slice of the stacked moe params (wr [D, E],
+    wi [E, D, M], bi [E, M], wo [E, M, D], bo [E, D]) — gpt2.forward's
+    lax.scan slices the leading layer axis before calling in here.
+    """
+    b, t, d = h.shape
+    s = b * t
+    e = cfg.num_experts
+    k = cfg.experts_per_token
+    c = capacity(cfg, s)
+    x = h.reshape(s, d)
+
+    # Router in f32: tiny matmul, and softmax/top-k stability matters.
+    logits = jnp.einsum("sd,de->se", x.astype(jnp.float32),
+                        mp["wr"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                  # [S, E]
+    top_w, top_i = jax.lax.top_k(probs, k)                   # [S, k]
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)   # renormalize
+
+    # Position of each (slot, token) within its expert's capacity buffer.
+    # Slot-major priority: every token's FIRST choice outranks any token's
+    # second choice — the deterministic GShard ordering.
+    oh = jax.nn.one_hot(top_i, e, dtype=jnp.int32)           # [S, k, E]
+    ohf = oh.transpose(1, 0, 2).reshape(k * s, e)            # slot-major
+    pos = jnp.cumsum(ohf, axis=0) - ohf                      # [k*s, E]
+    pos = jnp.sum(pos * ohf, axis=-1)                        # [k*s]
+    keep = pos < c
+
+    slot_oh = jax.nn.one_hot(pos, c, dtype=jnp.float32)      # [k*s, C]
+    disp_f = (
+        ohf.astype(jnp.float32)[:, :, None]
+        * slot_oh[:, None, :]
+        * keep.astype(jnp.float32)[:, None, None]
+    ).reshape(k, s, e, c)
+    dispatch = jnp.sum(disp_f, axis=0)                       # [S, E, C] 0/1
+    w_f = top_w.transpose(1, 0).reshape(k, s, 1, 1)
+    combine = jnp.sum(disp_f * w_f, axis=0)                  # [S, E, C]
+
+    dtype = h.dtype
+    expert_in = jnp.einsum(
+        "sec,sd->ecd", dispatch.astype(dtype), x
+    )                                                        # [E, C, D]
+    mid = jnp.einsum("ecd,edm->ecm", expert_in, mp["wi"].astype(dtype))
+    mid = jax.nn.gelu(
+        mid + mp["bi"].astype(mid.dtype)[:, None, :], approximate=True
+    )
+    out = jnp.einsum("ecm,emd->ecd", mid, mp["wo"].astype(dtype))
+    out = out + mp["bo"].astype(out.dtype)[:, None, :]
+    y = jnp.einsum("sec,ecd->sd", combine.astype(dtype), out)
+    return y.reshape(b, t, d)
+
+
+def load_balance_loss(params: Params, cfg: GPT2MoEConfig,
+                      hidden: jax.Array, layer: int) -> jax.Array:
+    """Switch aux loss for one layer: E * sum_e(frac_tokens_e * mean_prob_e).
+    Exposed for training experiments; serving ignores it."""
+    mp = jax.tree.map(lambda a: a[layer], params["blocks"]["moe"])
+    b, t, d = hidden.shape
+    x = hidden.reshape(b * t, d).astype(jnp.float32)
+    probs = jax.nn.softmax(x @ mp["wr"].astype(jnp.float32), axis=-1)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac = jnp.mean(
+        jax.nn.one_hot(top1, cfg.num_experts, dtype=jnp.float32), axis=0
+    )
+    return cfg.num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
+
+
+# The family surface: the trunk IS gpt2.forward (apply_block routes the
+# MLP through moe_mlp when the block params carry a `moe` subtree).
+forward = gpt2.forward
+init_cache = gpt2.init_cache
+
+
+def params_from_hf(sd, cfg):
+    raise NotImplementedError(
+        "no public HF GPT-2-MoE checkpoint layout to convert; train or "
+        "init locally"
+    )

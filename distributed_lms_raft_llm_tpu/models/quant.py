@@ -52,6 +52,14 @@ _QUANT_LEAVES = {
         ("blocks", "mlp", "wu"),
         ("blocks", "mlp", "wd"),
     },
+    # MoE: the gpt2-shared trunk leaves quantize; expert stacks stay dense
+    # (moe_mlp's batched einsums read them directly — int8 experts would
+    # need dequant folded into the E-leading matmuls; future work).
+    "gpt2_moe": {
+        ("wte",),
+        ("blocks", "attn", "wqkv"),
+        ("blocks", "attn", "wo"),
+    },
     "bert": {
         ("embeddings", "word"),
         ("blocks", "attn", "wqkv"),

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, NamedTuple, Tuple
 
-from . import convert, gpt2, llama
+from . import convert, gpt2, llama, moe
 
 
 class ModelFamily(NamedTuple):
@@ -36,6 +36,10 @@ LLAMA_FAMILY = ModelFamily(
     "llama", llama.init_params, llama.forward, llama.init_cache,
     convert.llama_params_from_hf,
 )
+MOE_FAMILY = ModelFamily(
+    "gpt2_moe", moe.init_params, moe.forward, moe.init_cache,
+    moe.params_from_hf,
+)
 
 # preset -> (family, config factory)
 PRESETS = {
@@ -46,6 +50,8 @@ PRESETS = {
     "tiny": (GPT2_FAMILY, gpt2.GPT2Config.tiny),
     "llama3-8b": (LLAMA_FAMILY, llama.LlamaConfig.llama3_8b),
     "llama-tiny": (LLAMA_FAMILY, llama.LlamaConfig.tiny),
+    "gpt2-moe": (MOE_FAMILY, moe.GPT2MoEConfig.moe_small),
+    "moe-tiny": (MOE_FAMILY, moe.GPT2MoEConfig.tiny),
 }
 
 

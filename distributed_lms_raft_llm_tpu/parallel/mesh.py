@@ -11,6 +11,7 @@ ICI. Axes used across the framework:
   and Llama-3-8B/16-chip configs)
 - ``sp`` — sequence/context parallel (ring attention for long context)
 - ``pp`` — pipeline stages (train-time; optional)
+- ``ep`` — expert parallel (MoE expert shards; models/moe.py)
 """
 
 from __future__ import annotations
@@ -27,7 +28,7 @@ def make_mesh(
     axis_sizes: Optional[dict] = None,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
-    axis_order: Tuple[str, ...] = ("dp", "pp", "sp", "tp"),
+    axis_order: Tuple[str, ...] = ("dp", "pp", "ep", "sp", "tp"),
 ) -> Mesh:
     """Build a mesh over the given (default: all local) devices.
 
@@ -98,7 +99,7 @@ def make_hybrid_mesh(
     ici_axis_sizes: dict,
     dcn_axis_sizes: Optional[dict] = None,
     *,
-    axis_order: Tuple[str, ...] = ("dp", "pp", "sp", "tp"),
+    axis_order: Tuple[str, ...] = ("dp", "pp", "ep", "sp", "tp"),
 ) -> Mesh:
     """DCN × ICI hybrid mesh for multi-host topologies.
 

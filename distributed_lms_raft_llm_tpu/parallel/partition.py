@@ -88,6 +88,19 @@ BERT_RULES: List[Tuple[str, PartitionSpec]] = [
     (r".*", P()),
 ]
 
+# GPT-2-MoE (models/moe.py): the dense trunk shards like GPT-2; the
+# expert stacks [L, E, D, M] shard their EXPERT axis over `ep` — under jit
+# the dispatch/combine einsums against ep-sharded weights make XLA place
+# each expert's FFN on its shard and insert the all-to-alls, exactly as
+# the tp specs imply the Megatron psums. The tiny router is replicated.
+MOE_RULES: List[Tuple[str, PartitionSpec]] = [
+    (r"blocks/moe/wr$", P(None, None, None)),
+    (r"blocks/moe/wi$", P(None, "ep", None, None)),
+    (r"blocks/moe/bi$", P(None, "ep", None)),
+    (r"blocks/moe/wo$", P(None, "ep", None, None)),
+    (r"blocks/moe/bo$", P(None, "ep", None)),
+] + GPT2_RULES
+
 # Rule set per model-family name (models/registry.py ModelFamily.name).
 # (KV-cache sharding — [L, B, Hkv, T, Dh]: batch over dp, heads over tp —
 # is derived by jit's sharding propagation from the param/batch specs; no
@@ -96,6 +109,7 @@ RULES_FOR = {
     "gpt2": GPT2_RULES,
     "llama": LLAMA_RULES,
     "bert": BERT_RULES,
+    "gpt2_moe": MOE_RULES,
 }
 
 

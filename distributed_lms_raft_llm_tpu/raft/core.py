@@ -613,6 +613,11 @@ class RaftCore:
             return
         if self.role is not Role.LEADER or resp.term != self.current_term:
             return
+        # Same quiet rule as broadcast_append: once TimeoutNow has fired,
+        # no more appends to the campaigning target — including the
+        # immediate retries below, which would otherwise ping-pong against
+        # its campaign-window rejections once per RTT.
+        quiet = peer == self.transfer_target and self._timeout_now_sent
         if resp.success:
             if resp.match_index > self.match_index.get(peer, 0):
                 self.match_index[peer] = resp.match_index
@@ -621,7 +626,7 @@ class RaftCore:
             self._maybe_fire_timeout_now(now)
             # Keep streaming if the peer is still behind — otherwise catch-up
             # would be paced at max_entries_per_append per heartbeat.
-            if self.next_index[peer] <= self.last_log_index:
+            if not quiet and self.next_index[peer] <= self.last_log_index:
                 msg = self.append_request_for(peer, now)
                 if msg is not None:
                     self.outbox.append((peer, msg))
@@ -630,6 +635,8 @@ class RaftCore:
                 self.next_index[peer] = max(1, resp.conflict_index)
             else:
                 self.next_index[peer] = max(1, self.next_index.get(peer, 1) - 1)
+            if quiet:
+                return
             # Retry immediately with the corrected window.
             msg = self.append_request_for(peer, now)
             if msg is not None:
@@ -667,7 +674,8 @@ class RaftCore:
             # second TimeoutNow and split the transfer vote between two
             # lease-bypassing candidates.
             raise TransferInFlight(self.transfer_target)
-        candidates = [p for p in self.peer_ids if p in self.members]
+        # peer_ids IS the membership minus self (_refresh_membership).
+        candidates = list(self.peer_ids)
         if not candidates:
             raise ValueError("no other member to transfer leadership to")
         if target is None:

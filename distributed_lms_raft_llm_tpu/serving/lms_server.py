@@ -125,8 +125,10 @@ async def serve_async(args) -> None:
             chosen = await lms_node.node.transfer_leadership(
                 None if target is None else int(target)
             )
-            return {"ok": True, "target": chosen,
-                    "leader_id": lms_node.node.leader_id}
+            # No leader_id here: this node just abdicated, and its local
+            # view stays stale until the new leader's first append — the
+            # target IS the expected leader; clients re-resolve as usual.
+            return {"ok": True, "target": chosen}
         if path != "/admin/membership":
             raise KeyError(path)
         op = body.get("op")

@@ -160,6 +160,10 @@ def main(argv=None) -> None:
     parser.add_argument("--vocab", default=None, help="GPT-2 vocab.json")
     parser.add_argument("--merges", default=None, help="GPT-2 merges.txt")
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel ways (MoE presets "
+                             "gpt2-moe/moe-tiny; experts shard over the "
+                             "ep mesh axis)")
     parser.add_argument(
         "--quant", default=None, choices=["int8"],
         help="weight-only int8 serving (halves the parameter bytes the "
@@ -216,6 +220,7 @@ def main(argv=None) -> None:
         apply_file_defaults(args, parser, {
             "port": t.port, "model": t.model, "checkpoint": t.checkpoint,
             "vocab": t.vocab, "merges": t.merges, "tp": t.tp,
+            "ep": t.ep,
             "quant": t.quant, "max_new_tokens": s.max_new_tokens,
             "max_batch": t.max_batch, "max_wait_ms": t.max_wait_ms,
             "slots": t.slots, "chunk": t.chunk,
@@ -260,6 +265,7 @@ def main(argv=None) -> None:
         merges_path=args.merges,
         sampling=sampling,
         tp=args.tp,
+        ep=args.ep,
         quant=args.quant,
         kv_quant=args.kv_quant,
         spec_tokens=args.spec_tokens,

@@ -47,4 +47,4 @@ def test_hybrid_mesh_rejects_unknown_axis():
     import pytest
 
     with pytest.raises(ValueError, match="unknown mesh axes"):
-        make_hybrid_mesh({"ep": 2})
+        make_hybrid_mesh({"zz": 2})
