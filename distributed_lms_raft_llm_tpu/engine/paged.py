@@ -257,8 +257,16 @@ class PagedEngine:
                 "spec_tokens is not supported by the paged engine; use "
                 "TutoringEngine for speculative decoding"
             )
-        self.mesh = mesh_lib.make_mesh({"tp": config.tp, "dp": -1},
-                                       devices=devices)
+        if config.ep > 1 and self.family.name != "gpt2_moe":
+            # Mirror TutoringEngine: silently replicating the ep ways into
+            # dp would waste an ep-factor of devices with no signal.
+            raise ValueError(
+                f"ep={config.ep} requires an MoE family; {config.model!r} "
+                f"has no expert axis to shard"
+            )
+        self.mesh = mesh_lib.make_mesh(
+            {"tp": config.tp, "ep": config.ep, "dp": -1}, devices=devices
+        )
         self.tokenizer = tok_lib.load_gpt2_tokenizer(
             config.vocab_path, config.merges_path, config.tokenizer_json
         )

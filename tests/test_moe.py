@@ -85,19 +85,43 @@ class TestMoELayer:
         assert 0 < nonzero <= cfg.num_experts
 
     def test_slot_priority_is_first_choice_first(self):
-        # With capacity exactly S*k/E and a forced collision, a token's
-        # FIRST choice must win a buffer slot over another token's second
-        # choice — check by comparing against brute force at cf=1.0 where
-        # ordering decides who is dropped: the layer must be deterministic
-        # and produce zeros only for over-capacity (slot-major-later) picks.
-        cfg = moe.GPT2MoEConfig.tiny(capacity_factor=1.0)
+        # Crafted collision at capacity 1: token A prefers E0 then E1,
+        # token B prefers E1 then E0. Slot-major priority means BOTH get
+        # their FIRST choice (all first picks outrank any second pick) and
+        # both second picks are dropped — so each token's output is its
+        # renormalized-first-choice expert alone. An inverted priority
+        # would hand each token its SECOND choice instead, which this
+        # assertion distinguishes.
+        cfg = moe.GPT2MoEConfig.tiny(capacity_factor=1e-9)  # C = 1
         params = moe.init_params(jax.random.key(0), cfg)
-        mp = _layer0(params)
-        h = jax.random.normal(jax.random.key(3), (2, 6, cfg.hidden_size),
-                              jnp.float32)
-        y1 = np.asarray(moe.moe_mlp(h, mp, cfg))
-        y2 = np.asarray(moe.moe_mlp(h, mp, cfg))
-        np.testing.assert_array_equal(y1, y2)  # deterministic
+        mp = dict(_layer0(params))
+        d, e = cfg.hidden_size, cfg.num_experts
+        wr = np.full((d, e), -30.0, np.float32)
+        wr[0, 0], wr[0, 1] = 3.0, 2.0   # token A = e_0: E0 > E1
+        wr[1, 1], wr[1, 0] = 3.0, 2.0   # token B = e_1: E1 > E0
+        mp["wr"] = jnp.asarray(wr)
+        h = np.zeros((1, 2, d), np.float32)
+        h[0, 0, 0] = 1.0  # token A
+        h[0, 1, 1] = 1.0  # token B
+        assert moe.capacity(cfg, 2) == 1
+        y = np.asarray(moe.moe_mlp(jnp.asarray(h), mp, cfg))[0]
+
+        def expert(x, idx):
+            wi = np.asarray(mp["wi"][idx], np.float64)
+            bi = np.asarray(mp["bi"][idx], np.float64)
+            wo = np.asarray(mp["wo"][idx], np.float64)
+            bo = np.asarray(mp["bo"][idx], np.float64)
+            v = x @ wi + bi
+            g = 0.5 * v * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                       * (v + 0.044715 * v**3)))
+            return g @ wo + bo
+
+        # Renormalized first-choice weight: softmax(3,2) over the top-2.
+        w1 = float(np.exp(3.0) / (np.exp(3.0) + np.exp(2.0)))
+        exp_a = w1 * expert(np.asarray(h[0, 0], np.float64), 0)
+        exp_b = w1 * expert(np.asarray(h[0, 1], np.float64), 1)
+        np.testing.assert_allclose(y[0], exp_a, atol=2e-4)
+        np.testing.assert_allclose(y[1], exp_b, atol=2e-4)
 
     def test_load_balance_loss_positive_and_bounded(self):
         cfg = moe.GPT2MoEConfig.tiny()

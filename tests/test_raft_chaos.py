@@ -256,3 +256,108 @@ def test_chaos_with_membership_changes_preserves_safety():
                 await n.stop()
 
     asyncio.run(run())
+
+
+def test_chaos_with_leadership_transfers_preserves_safety():
+    """Chaos soak with deliberate leadership transfers interleaved with
+    partitions, drops, and writes: the §3.10 machinery (TimeoutNow,
+    lease-bypassing transfer votes, proposal blocking, deadline aborts)
+    must never violate single-leader-per-term, prefix consistency, or
+    acked-write durability — even when the chosen target is partitioned
+    away mid-transfer."""
+
+    async def run():
+        from distributed_lms_raft_llm_tpu.raft import TransferInFlight
+
+        rng = random.Random(0x7A5F3A)
+        net = MemNetwork()
+        applied = {}
+        nodes, _ = build_cluster(net, 5, applied=applied)
+        for n in nodes.values():
+            await n.start()
+        await wait_for_leader(nodes)
+
+        acked = []
+        seq = 0
+        transfers_ok = 0
+
+        async def try_write():
+            nonlocal seq
+            leaders = [n for n in nodes.values() if n.is_leader]
+            if not leaders:
+                return
+            cmd = encode_command("set", {"n": seq})
+            seq += 1
+            try:
+                await asyncio.wait_for(leaders[0].propose(cmd), 0.6)
+                acked.append(cmd)
+            except (NotLeader, TransferInFlight, TimeoutError,
+                    asyncio.TimeoutError, RuntimeError):
+                pass
+
+        async def try_transfer():
+            nonlocal transfers_ok
+            leaders = [n for n in nodes.values() if n.is_leader]
+            if not leaders:
+                return
+            target = rng.choice(
+                [i for i in nodes if i != leaders[0].node_id]
+            )
+            try:
+                await leaders[0].transfer_leadership(target, timeout=1.0)
+                transfers_ok += 1
+            except (NotLeader, TransferInFlight, TimeoutError,
+                    ValueError, RuntimeError):
+                pass  # target unreachable / deposed meanwhile — both legal
+
+        for round_no in range(12):
+            fault = rng.random()
+            ids = list(nodes)
+            if fault < 0.3:
+                rng.shuffle(ids)
+                cut = rng.randint(1, 2)
+                net.partition(set(ids[:cut]), set(ids[cut:]))
+            elif fault < 0.55:
+                net.drop_pairs = {
+                    (rng.choice(ids), rng.choice(ids)) for _ in range(4)
+                }
+            else:
+                net.heal()
+            for _ in range(rng.randint(1, 3)):
+                await try_write()
+                await asyncio.sleep(rng.uniform(0.01, 0.06))
+            await try_transfer()
+            by_term = {}
+            for n in nodes.values():
+                if n.is_leader:
+                    by_term.setdefault(n.core.current_term, []).append(
+                        n.node_id
+                    )
+            for term, leaders in by_term.items():
+                assert len(leaders) == 1, f"two leaders in term {term}"
+
+        net.heal()
+        leader = await wait_for_leader(nodes)
+        for _ in range(3):
+            try:
+                await asyncio.wait_for(leader.read_barrier(), 2.0)
+                break
+            except (NotLeader, TimeoutError, asyncio.TimeoutError):
+                leader = await wait_for_leader(nodes)
+        await asyncio.sleep(0.5)
+
+        sequences = {
+            i: [cmd for _, cmd in applied.get(i, [])] for i in nodes
+        }
+        reference_seq = sequences[leader.node_id]
+        for i, cmds in sequences.items():
+            assert cmds == reference_seq[: len(cmds)], f"divergence on {i}"
+        for cmd in acked:
+            assert reference_seq.count(cmd) == 1, f"acked write lost: {cmd}"
+        assert len(acked) >= 3, "chaos schedule never committed anything"
+        assert transfers_ok >= 2, "no transfer ever completed under chaos"
+
+        for n in nodes.values():
+            await n.stop()
+
+    asyncio.run(run())
