@@ -144,12 +144,16 @@ def init_cache(cfg: GPT2Config, batch: int, max_len: int, dtype=None) -> KVCache
     )
 
 
-def apply_block(x, lp, attend_fn, cfg: GPT2Config):
+def apply_block(x, lp, attend_fn, cfg: GPT2Config, collect_aux: bool = False):
     """One transformer block; `attend_fn(q, k_new, v_new) -> context` owns
     cache handling + attention so every path (dense, ring, cached decode,
     pipeline stage) shares one copy of the math. Blocks whose params carry
     a `moe` subtree instead of `mlp` route the feed-forward through the
-    expert layer (models/moe.py) — same trunk, cache, and decode paths."""
+    expert layer (models/moe.py) — same trunk, cache, and decode paths.
+
+    collect_aux=True returns (x, aux) where aux is the block's MoE
+    load-balance scalar (0 for dense blocks) — the training objective's
+    side channel."""
     eps = cfg.layer_norm_eps
     h = layer_norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"], eps)
     qkv = dense(h, lp["attn"]["wqkv"], lp["attn"]["bqkv"])
@@ -164,10 +168,15 @@ def apply_block(x, lp, attend_fn, cfg: GPT2Config):
     if "moe" in lp:
         from . import moe as moe_lib
 
+        if collect_aux:
+            y, aux = moe_lib.moe_mlp(h2, lp["moe"], cfg, return_aux=True)
+            return x + y, aux
         return x + moe_lib.moe_mlp(h2, lp["moe"], cfg)
     m = dense(h2, lp["mlp"]["wi"], lp["mlp"]["bi"])
     m = jax.nn.gelu(m, approximate=True)  # GPT-2 uses the tanh approximation
     x = x + dense(m, lp["mlp"]["wo"], lp["mlp"]["bo"])
+    if collect_aux:
+        return x, jnp.zeros((), jnp.float32)
     return x
 
 

@@ -49,7 +49,8 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from . import gpt2
+from . import gpt2, quant
+from .common import attend, layer_norm
 
 Params = Dict[str, Any]
 
@@ -110,12 +111,18 @@ def capacity(cfg: GPT2MoEConfig, tokens: int) -> int:
     )
 
 
-def moe_mlp(h: jax.Array, mp: Dict[str, jax.Array], cfg) -> jax.Array:
+def moe_mlp(h: jax.Array, mp: Dict[str, jax.Array], cfg,
+            return_aux: bool = False):
     """The expert layer: [B, T, D] -> [B, T, D] (residual not included).
 
     mp holds ONE layer's slice of the stacked moe params (wr [D, E],
     wi [E, D, M], bi [E, M], wo [E, M, D], bo [E, D]) — gpt2.forward's
     lax.scan slices the leading layer axis before calling in here.
+
+    return_aux=True additionally returns this layer's Switch load-balance
+    scalar (E * sum_e frac_top1_e * mean_prob_e; 1.0 when perfectly
+    balanced) for the training objective — computed from the router probs
+    already in hand, so the serving path pays nothing for it.
     """
     b, t, d = h.shape
     s = b * t
@@ -161,7 +168,12 @@ def moe_mlp(h: jax.Array, mp: Dict[str, jax.Array], cfg) -> jax.Array:
     out = jnp.einsum("ecm,emd->ecd", mid, mp["wo"].astype(dtype))
     out = out + mp["bo"].astype(out.dtype)[:, None, :]
     y = jnp.einsum("sec,ecd->sd", combine.astype(dtype), out)
-    return y.reshape(b, t, d)
+    y = y.reshape(b, t, d)
+    if not return_aux:
+        return y
+    frac = jnp.mean(oh[:, 0].astype(jnp.float32), axis=0)  # top-1 share
+    aux = e * jnp.sum(frac * jnp.mean(probs, axis=0))
+    return y, aux
 
 
 def load_balance_loss(params: Params, cfg: GPT2MoEConfig,
@@ -179,6 +191,35 @@ def load_balance_loss(params: Params, cfg: GPT2MoEConfig,
     return cfg.num_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
 
 
+def forward_with_aux(params: Params, cfg: GPT2MoEConfig,
+                     input_ids: jax.Array):
+    """Full-sequence forward returning (logits, mean load-balance aux) —
+    the training path. Same math as gpt2.forward's cache-less trunk, with
+    each block's aux scalar accumulated through the scan carry (a pure
+    side channel; serving uses gpt2.forward and never computes it)."""
+    b, t = input_ids.shape
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    x = quant.embed_lookup(params["wte"], input_ids) + params["wpe"][positions]
+    x = x.astype(cfg.dtype)
+    pos = jnp.arange(t)
+    mask = (pos[None, :] <= pos[:, None])[None, None]
+
+    def body(carry, lp):
+        h, aux = carry
+        y, a = gpt2.apply_block(
+            h, lp, lambda q, k, v: attend(q, k, v, mask), cfg,
+            collect_aux=True,
+        )
+        return (y, aux + a), None
+
+    (x, aux), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+    )
+    x = layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"],
+                   cfg.layer_norm_eps)
+    return quant.unembed(x, params["wte"]), aux / cfg.num_layers
+
+
 # The family surface: the trunk IS gpt2.forward (apply_block routes the
 # MLP through moe_mlp when the block params carry a `moe` subtree).
 forward = gpt2.forward
@@ -186,7 +227,27 @@ init_cache = gpt2.init_cache
 
 
 def params_from_hf(sd, cfg):
-    raise NotImplementedError(
-        "no public HF GPT-2-MoE checkpoint layout to convert; train or "
-        "init locally"
-    )
+    """Load an MoE checkpoint. There is no public HF GPT-2-MoE layout, so
+    checkpoints use the NATIVE tree layout with slash-joined key paths
+    (written by train.checkpoint.export_model) — rebuilt into the param
+    pytree here so `TutoringEngine(model="gpt2-moe", checkpoint=...)`
+    serves a locally-trained MoE through the standard path."""
+    if not any("/" in k for k in sd):
+        raise ValueError(
+            "MoE checkpoints use the native slash-joined layout (written "
+            "by train export); this file looks like an HF state dict, "
+            "which has no GPT-2-MoE counterpart"
+        )
+    tree: Params = {}
+    for key, value in sd.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(value, cfg.param_dtype)
+    missing = {"wte", "wpe", "blocks", "lnf"} - set(tree)
+    if missing or "moe" not in tree.get("blocks", {}):
+        raise ValueError(
+            f"native MoE checkpoint is missing {sorted(missing) or ['blocks/moe']}"
+        )
+    return tree

@@ -94,8 +94,13 @@ def restore_train_state(
 def export_model(path: str, state: Any) -> None:
     """Write just the fine-tuned parameters in HF GPT-2 layout (the inverse
     of the import mapping), so `TutoringEngine(checkpoint=path)` serves the
-    fine-tuned model through the standard checkpoint path."""
+    fine-tuned model through the standard checkpoint path. MoE params have
+    no HF counterpart layout; they export in the native tree layout
+    (slash-joined paths), which `models.moe.params_from_hf` reads back."""
     params = jax.device_get(state["params"])
+    if "moe" in params.get("blocks", {}):
+        convert.save_safetensors(path, _flatten(params))
+        return
     convert.save_safetensors(path, convert.gpt2_params_to_hf(params))
 
 

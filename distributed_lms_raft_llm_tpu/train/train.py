@@ -21,7 +21,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models import gpt2
+from ..models import gpt2, moe
 from ..parallel import partition
 
 
@@ -37,6 +37,18 @@ class TrainConfig:
     # stacked trunk pipelines via parallel.pipeline.pipeline_trunk; bubble
     # fraction (pp-1)/(pp_micro+pp-1)).
     pp_micro: int = 2
+    # MoE: weight of the Switch load-balance aux loss (models/moe.py,
+    # applies only to GPT2MoEConfig models — keeps the router from
+    # collapsing onto a few experts).
+    moe_aux_weight: float = 0.01
+
+
+def _is_moe(model_cfg) -> bool:
+    return isinstance(model_cfg, moe.GPT2MoEConfig)
+
+
+def _init_params_for(model_cfg):
+    return moe.init_params if _is_moe(model_cfg) else gpt2.init_params
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
@@ -65,7 +77,7 @@ def lm_loss(
 def init_train_state(
     rng: jax.Array, model_cfg: gpt2.GPT2Config, optimizer
 ) -> Dict[str, Any]:
-    params = gpt2.init_params(rng, model_cfg)
+    params = _init_params_for(model_cfg)(rng, model_cfg)
     return {
         "params": params,
         "opt_state": optimizer.init(params),
@@ -78,10 +90,15 @@ def train_state_shardings(state, mesh: Mesh):
     follow the model partition rules (adam mu/nu mirror param shapes);
     scalars replicate. A pp axis > 1 additionally shards every stacked
     block leaf's leading layer axis over pp — each pipeline stage stores
-    only its own L/pp layers (and their optimizer moments)."""
+    only its own L/pp layers (and their optimizer moments). MoE states are
+    recognized by their param structure and use the gpt2_moe rules
+    (experts over ep)."""
 
+    is_moe_state = "moe" in state["params"].get("blocks", {})
     param_specs = partition.match_partition_rules(
-        partition.GPT2_RULES, state["params"]
+        partition.RULES_FOR["gpt2_moe"] if is_moe_state
+        else partition.GPT2_RULES,
+        state["params"],
     )
     if mesh.shape.get("pp", 1) > 1:
         param_specs["blocks"] = jax.tree.map(
@@ -123,6 +140,7 @@ def make_train_step(
     remat: bool = True,
     mesh: Optional[Mesh] = None,
     pp_micro: int = 2,
+    moe_aux_weight: float = 0.01,
 ) -> Callable:
     """Returns train_step(state, batch) -> (state, metrics); jit it with the
     shardings from `train_state_shardings` + batch over dp.
@@ -134,9 +152,20 @@ def make_train_step(
       (gpt2.forward_pipelined) with `pp_micro` microbatches, layer weights
       stage-sharded per `train_state_shardings`.
     """
+    is_moe = _is_moe(model_cfg)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        if is_moe:
+            raise ValueError(
+                "sp and MoE cannot combine yet: moe.forward_with_aux uses "
+                "dense full-sequence attention (no ring routing)"
+            )
         model_cfg = dataclasses.replace(model_cfg, ring_mesh=mesh)
     pipelined = mesh is not None and mesh.shape.get("pp", 1) > 1
+    if pipelined and is_moe:
+        raise ValueError(
+            "pp and MoE cannot combine yet: the pipeline stage body has "
+            "no aux-loss channel; use ep x tp x dp"
+        )
 
     if pipelined:
         # Combinations the pipeline schedule does not implement yet — fail
@@ -164,20 +193,22 @@ def make_train_step(
             )
             return logits, None
     else:
-        forward = gpt2.forward
+        forward = moe.forward_with_aux if is_moe else gpt2.forward
         if remat:
-            forward = jax.checkpoint(
-                partial(gpt2.forward), static_argnums=(1,)
-            )
+            forward = jax.checkpoint(partial(forward), static_argnums=(1,))
 
     def loss_fn(params, input_ids, loss_mask):
-        logits, _ = forward(params, model_cfg, input_ids)
+        if is_moe:
+            logits, aux = forward(params, model_cfg, input_ids)
+        else:
+            logits, _ = forward(params, model_cfg, input_ids)
+            aux = 0.0
         # next-token prediction: shift by one
         loss = lm_loss(logits[:, :-1], input_ids[:, 1:], loss_mask[:, 1:])
-        return loss
+        return loss + moe_aux_weight * aux, aux
 
     def train_step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], batch["input_ids"], batch["loss_mask"]
         )
         updates, opt_state = optimizer.update(
@@ -190,7 +221,10 @@ def make_train_step(
             "step": state["step"] + 1,
         }
         gnorm = optax.global_norm(grads)
-        return new_state, {"loss": loss, "grad_norm": gnorm}
+        metrics = {"loss": loss, "grad_norm": gnorm}
+        if is_moe:
+            metrics["moe_balance"] = aux
+        return new_state, metrics
 
     return train_step
 
@@ -219,7 +253,8 @@ def make_sharded_train_step(
     }
     step = jax.jit(
         make_train_step(model_cfg, optimizer, remat=train_cfg.remat,
-                        mesh=mesh, pp_micro=train_cfg.pp_micro),
+                        mesh=mesh, pp_micro=train_cfg.pp_micro,
+                        moe_aux_weight=train_cfg.moe_aux_weight),
         in_shardings=(state_shardings, batch_sharding),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
@@ -338,6 +373,10 @@ def main(argv=None) -> None:
                         "L/pp layers per device (GPipe microbatching)")
     parser.add_argument("--pp-micro", type=int, default=2,
                         help="microbatches per step when --pp > 1")
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel ways (MoE presets: expert "
+                        "stacks shard over ep; aux load-balance loss is "
+                        "applied automatically)")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -348,8 +387,14 @@ def main(argv=None) -> None:
         args.data, tokenizer,
         DataConfig(batch_size=args.batch_size, seq_len=args.seq_len),
     )
+    if args.ep > 1 and not _is_moe(model_cfg):
+        parser.error(
+            f"--ep {args.ep} requires an MoE model preset; {args.model!r} "
+            f"has no expert axis — the ep chips would silently replicate"
+        )
     mesh = mesh_lib.make_mesh(
-        {"pp": args.pp, "sp": args.sp, "tp": args.tp, "dp": -1}
+        {"pp": args.pp, "ep": args.ep, "sp": args.sp, "tp": args.tp,
+         "dp": -1}
     )
     steps = args.epochs * dataset.steps_per_epoch()
     train_cfg = TrainConfig(
