@@ -136,6 +136,9 @@ def bench_torch_baseline(model: str = "gpt2", budget_new_tokens: int = 32) -> fl
     """Reference path: torch-CPU GPT-2 (matching size), sequential queries."""
     arch = {
         "gpt2": dict(),
+        # The reference has no MoE; its comparable is the same dense trunk
+        # (gpt2-moe activates ~gpt2-small FLOPs per token).
+        "gpt2-moe": dict(),
         "gpt2-medium": dict(n_embd=1024, n_layer=24, n_head=16),
         "gpt2-large": dict(n_embd=1280, n_layer=36, n_head=20),
     }[model]
@@ -174,8 +177,11 @@ def main() -> None:
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2",
-                    choices=["gpt2", "gpt2-medium", "gpt2-large"],
-                    help="BASELINE config to bench (default: the headline)")
+                    choices=["gpt2", "gpt2-medium", "gpt2-large",
+                             "gpt2-moe"],
+                    help="BASELINE config to bench (default: the headline; "
+                         "gpt2-moe = 8-expert top-2 small trunk, random "
+                         "init)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel ways (config 4: gpt2-large tp)")
     ap.add_argument("--batch", type=int, default=BATCH,
@@ -195,7 +201,7 @@ def main() -> None:
 
         t = load_config(args.config).tutoring
         if args.model == "gpt2" and t.model in ("gpt2", "gpt2-medium",
-                                                "gpt2-large"):
+                                                "gpt2-large", "gpt2-moe"):
             args.model = t.model
         if args.tp == 1:
             args.tp = t.tp

@@ -248,7 +248,8 @@ def forward(
     cache: Optional[KVCache] = None,
     positions: Optional[jax.Array] = None,
     kv_mask: Optional[jax.Array] = None,
-) -> Tuple[jax.Array, Optional[KVCache]]:
+    collect_moe_aux: bool = False,
+):
     """Run the transformer; returns (logits [B, T, V] float32, updated cache).
 
     cache      — None for full-sequence (training / golden) mode; a KVCache
@@ -265,6 +266,11 @@ def forward(
                  slot indices (contiguous, no padding). The engine passes
                  per-row positions when prompts are left-padded.
     kv_mask    — [B, num_keys] validity of each key slot (False = padding).
+    collect_moe_aux — full-sequence (cache=None) mode only: additionally
+                 return the mean per-layer MoE load-balance scalar
+                 (models/moe.py; 0 for dense blocks) as a third element —
+                 the training objective's side channel. Composes with
+                 ring attention (the aux rides the scan carry either way).
     """
     b, t = input_ids.shape
     eps = cfg.layer_norm_eps
@@ -311,12 +317,30 @@ def forward(
         else:
             attend_full = lambda q, k, v: attend(q, k, v, mask)  # noqa: E731
 
-        def body(carry, lp):
-            return block(carry, lp, attend_full), None
+        if collect_moe_aux:
 
-        x, _ = jax.lax.scan(body, x, params["blocks"])
+            def body_aux(carry, lp):
+                h, aux = carry
+                y, a = apply_block(h, lp, attend_full, cfg,
+                                   collect_aux=True)
+                return (y, aux + a), None
+
+            (x, moe_aux), _ = jax.lax.scan(
+                body_aux, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+            )
+        else:
+
+            def body(carry, lp):
+                return block(carry, lp, attend_full), None
+
+            x, _ = jax.lax.scan(body, x, params["blocks"])
         new_cache = None
     else:
+        if collect_moe_aux:
+            raise ValueError(
+                "collect_moe_aux is a full-sequence (training) channel; "
+                "the cached decode path does not accumulate it"
+            )
         # The stacked cache rides the scan CARRY (updated in place per layer
         # via dynamic_update_slice at the layer index), not the scan xs/ys.
         # Threading it through xs/ys makes XLA re-stack — i.e. copy — the
@@ -425,4 +449,6 @@ def forward(
     # Tied unembedding (reference model ties lm_head to wte); f32 accumulation
     # so sampling sees full-precision logits even in bfloat16 compute.
     logits = quant.unembed(x, params["wte"])
+    if collect_moe_aux:
+        return logits, new_cache, moe_aux / cfg.num_layers
     return logits, new_cache

@@ -49,8 +49,7 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from . import gpt2, quant
-from .common import attend, layer_norm
+from . import gpt2
 
 Params = Dict[str, Any]
 
@@ -161,11 +160,21 @@ def moe_mlp(h: jax.Array, mp: Dict[str, jax.Array], cfg,
     expert_in = jnp.einsum(
         "sec,sd->ecd", dispatch.astype(dtype), x
     )                                                        # [E, C, D]
-    mid = jnp.einsum("ecd,edm->ecm", expert_in, mp["wi"].astype(dtype))
+
+    def expert_dense(inp, spec, w):
+        """Batched expert matmul; weight-only-int8 pairs {q, s} dequantize
+        via the per-out-channel scale AFTER the dot (the int8 operand
+        streams at half the bytes, same scheme as common.dense)."""
+        if isinstance(w, dict):
+            y = jnp.einsum(spec, inp, w["q"].astype(inp.dtype))
+            return y * w["s"].astype(y.dtype)[:, None, :]
+        return jnp.einsum(spec, inp, w.astype(inp.dtype))
+
+    mid = expert_dense(expert_in, "ecd,edm->ecm", mp["wi"])
     mid = jax.nn.gelu(
         mid + mp["bi"].astype(mid.dtype)[:, None, :], approximate=True
     )
-    out = jnp.einsum("ecm,emd->ecd", mid, mp["wo"].astype(dtype))
+    out = expert_dense(mid, "ecm,emd->ecd", mp["wo"])
     out = out + mp["bo"].astype(out.dtype)[:, None, :]
     y = jnp.einsum("sec,ecd->sd", combine.astype(dtype), out)
     y = y.reshape(b, t, d)
@@ -194,30 +203,14 @@ def load_balance_loss(params: Params, cfg: GPT2MoEConfig,
 def forward_with_aux(params: Params, cfg: GPT2MoEConfig,
                      input_ids: jax.Array):
     """Full-sequence forward returning (logits, mean load-balance aux) —
-    the training path. Same math as gpt2.forward's cache-less trunk, with
-    each block's aux scalar accumulated through the scan carry (a pure
-    side channel; serving uses gpt2.forward and never computes it)."""
-    b, t = input_ids.shape
-    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
-    x = quant.embed_lookup(params["wte"], input_ids) + params["wpe"][positions]
-    x = x.astype(cfg.dtype)
-    pos = jnp.arange(t)
-    mask = (pos[None, :] <= pos[:, None])[None, None]
-
-    def body(carry, lp):
-        h, aux = carry
-        y, a = gpt2.apply_block(
-            h, lp, lambda q, k, v: attend(q, k, v, mask), cfg,
-            collect_aux=True,
-        )
-        return (y, aux + a), None
-
-    (x, aux), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+    the training path. ONE trunk: gpt2.forward with its aux side channel
+    on (collect_moe_aux), so the training and serving forwards cannot
+    drift, and ring attention (cfg.ring_mesh) composes with the aux the
+    same way it does for dense training."""
+    logits, _, aux = gpt2.forward(
+        params, cfg, input_ids, collect_moe_aux=True
     )
-    x = layer_norm(x, params["lnf"]["scale"], params["lnf"]["bias"],
-                   cfg.layer_norm_eps)
-    return quant.unembed(x, params["wte"]), aux / cfg.num_layers
+    return logits, aux
 
 
 # The family surface: the trunk IS gpt2.forward (apply_block routes the

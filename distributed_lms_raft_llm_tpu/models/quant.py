@@ -52,13 +52,16 @@ _QUANT_LEAVES = {
         ("blocks", "mlp", "wu"),
         ("blocks", "mlp", "wd"),
     },
-    # MoE: the gpt2-shared trunk leaves quantize; expert stacks stay dense
-    # (moe_mlp's batched einsums read them directly — int8 experts would
-    # need dequant folded into the E-leading matmuls; future work).
+    # MoE: the gpt2-shared trunk leaves plus the expert stacks — the
+    # per-out-channel scales for [L, E, D, M] land as [L, E, M] and fold
+    # into moe_mlp's batched expert einsums after the dot (expert_dense).
+    # The router stays dense (tiny, and softmax-sensitive).
     "gpt2_moe": {
         ("wte",),
         ("blocks", "attn", "wqkv"),
         ("blocks", "attn", "wo"),
+        ("blocks", "moe", "wi"),
+        ("blocks", "moe", "wo"),
     },
     "bert": {
         ("embeddings", "word"),

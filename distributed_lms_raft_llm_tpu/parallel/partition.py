@@ -95,10 +95,9 @@ BERT_RULES: List[Tuple[str, PartitionSpec]] = [
 # the tp specs imply the Megatron psums. The tiny router is replicated.
 MOE_RULES: List[Tuple[str, PartitionSpec]] = [
     (r"blocks/moe/wr$", P(None, None, None)),
-    (r"blocks/moe/wi$", P(None, "ep", None, None)),
-    (r"blocks/moe/bi$", P(None, "ep", None)),
-    (r"blocks/moe/wo$", P(None, "ep", None, None)),
-    (r"blocks/moe/bo$", P(None, "ep", None)),
+    (r"blocks/moe/w[io](/q)?$", P(None, "ep", None, None)),
+    (r"blocks/moe/w[io]/s$", P(None, "ep", None)),
+    (r"blocks/moe/b[io]$", P(None, "ep", None)),
 ] + GPT2_RULES
 
 # Rule set per model-family name (models/registry.py ModelFamily.name).

@@ -154,11 +154,8 @@ def make_train_step(
     """
     is_moe = _is_moe(model_cfg)
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        if is_moe:
-            raise ValueError(
-                "sp and MoE cannot combine yet: moe.forward_with_aux uses "
-                "dense full-sequence attention (no ring routing)"
-            )
+        # Composes with MoE too: forward_with_aux IS gpt2.forward, whose
+        # ring path carries the aux channel (parity-tested in test_moe).
         model_cfg = dataclasses.replace(model_cfg, ring_mesh=mesh)
     pipelined = mesh is not None and mesh.shape.get("pp", 1) > 1
     if pipelined and is_moe:
@@ -382,16 +379,17 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
 
     _, model_cfg = registry.resolve(args.model, jnp.bfloat16, jnp.float32)
+    if args.ep > 1 and not _is_moe(model_cfg):
+        # Before the (potentially minutes-long) corpus tokenization.
+        parser.error(
+            f"--ep {args.ep} requires an MoE model preset; {args.model!r} "
+            f"has no expert axis — the ep chips would silently replicate"
+        )
     tokenizer = tok_lib.load_gpt2_tokenizer(args.vocab, args.merges, None)
     dataset = PackedDataset.from_paths(
         args.data, tokenizer,
         DataConfig(batch_size=args.batch_size, seq_len=args.seq_len),
     )
-    if args.ep > 1 and not _is_moe(model_cfg):
-        parser.error(
-            f"--ep {args.ep} requires an MoE model preset; {args.model!r} "
-            f"has no expert axis — the ep chips would silently replicate"
-        )
     mesh = mesh_lib.make_mesh(
         {"pp": args.pp, "ep": args.ep, "sp": args.sp, "tp": args.tp,
          "dp": -1}

@@ -270,7 +270,7 @@ class TestTraining:
         np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
         assert isinstance(eng.answer_batch(["q"])[0], str)
 
-    def test_moe_refuses_pp_and_sp(self):
+    def test_moe_refuses_pp(self):
         import pytest as _pytest
 
         from distributed_lms_raft_llm_tpu.train import (
@@ -284,11 +284,30 @@ class TestTraining:
                 make_mesh({"pp": 2, "dp": -1}), cfg,
                 TrainConfig(warmup_steps=1), jax.random.key(0),
             )
-        with _pytest.raises(ValueError, match="sp and MoE"):
-            make_sharded_train_step(
-                make_mesh({"sp": 2, "dp": -1}), cfg,
-                TrainConfig(warmup_steps=1), jax.random.key(0),
-            )
+
+    def test_ring_attention_composes_with_moe_and_aux(self):
+        # sp x ep x dp: the ring-routed full-sequence forward and its aux
+        # channel must match the dense single-device forward exactly.
+        import dataclasses
+
+        cfg = moe.GPT2MoEConfig.tiny(dtype=jnp.float32,
+                                     param_dtype=jnp.float32)
+        params = moe.init_params(jax.random.key(0), cfg)
+        ids = jax.random.randint(jax.random.key(5), (4, 16), 0,
+                                 cfg.vocab_size)
+        ref, aux_ref = moe.forward_with_aux(params, cfg, ids)
+        mesh = make_mesh({"sp": 2, "ep": 2, "dp": -1})
+        ring_cfg = dataclasses.replace(cfg, ring_mesh=mesh)
+        sharded = partition.shard_tree(
+            params, mesh, partition.RULES_FOR["gpt2_moe"]
+        )
+        with mesh:
+            got, aux = jax.jit(
+                lambda p, i: moe.forward_with_aux(p, ring_cfg, i)
+            )(sharded, ids)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                                   rtol=2e-5, atol=2e-5)
+        assert abs(float(aux) - float(aux_ref)) < 1e-4
 
 
 class TestServing:
@@ -373,3 +392,35 @@ class TestServing:
             quant="int8", kv_quant=True,
         ))
         assert eng.answer_batch(["hello"])[0] is not None
+
+    def test_int8_experts_stay_close_to_dense(self):
+        # Weight-only int8 on the expert stacks (and trunk): the forward
+        # must track the full-precision one closely — same bar as the
+        # dense-model quant tests (top-1 agreement on most positions).
+        from distributed_lms_raft_llm_tpu.models import quant
+
+        cfg = moe.GPT2MoEConfig.tiny(dtype=jnp.float32,
+                                     param_dtype=jnp.float32)
+        params = moe.init_params(jax.random.key(0), cfg)
+        ids = jax.random.randint(jax.random.key(9), (2, 12), 0,
+                                 cfg.vocab_size)
+        ref, _ = moe.forward(params, cfg, ids)
+        qparams = quant.quantize_params(params, "gpt2_moe")
+        assert isinstance(qparams["blocks"]["moe"]["wi"], dict)  # quantized
+        got, _ = moe.forward(qparams, cfg, ids)
+        ref_np, got_np = np.asarray(ref), np.asarray(got)
+        agree = np.mean(
+            np.argmax(ref_np, axis=-1) == np.argmax(got_np, axis=-1)
+        )
+        assert agree >= 0.9, agree
+        # And the int8 expert stacks still shard over ep.
+        mesh = make_mesh({"ep": 4, "dp": -1})
+        sharded = partition.shard_tree(
+            qparams, mesh, partition.RULES_FOR["gpt2_moe"]
+        )
+        with mesh:
+            ep_logits, _ = jax.jit(lambda p, i: moe.forward(p, cfg, i))(
+                sharded, ids
+            )
+        np.testing.assert_allclose(got_np, np.asarray(ep_logits),
+                                   rtol=2e-5, atol=2e-5)
