@@ -57,6 +57,10 @@ class EngineConfig:
     # stacks shard over the `ep` mesh axis (parallel/partition.py
     # MOE_RULES). Composes with tp x dp; 1 for dense models.
     ep: int = 1
+    # Sequence-parallel ways for the SCORING path (engine.score): the
+    # full-sequence forward runs as ring attention over `sp` shards —
+    # the long-context direction. Generation's cached decode ignores sp.
+    sp: int = 1
     # Fused Pallas decode attention (ops/attention.py). None = off: with the
     # cache's [.., S, 64] head-dim-minor layout the kernel's DMA runs at
     # half-filled 128-lane tiles and measured slightly SLOWER end-to-end
@@ -125,7 +129,8 @@ class TutoringEngine:
                 "distributions than step decode (models/moe.py caveat)"
             )
         self.mesh = mesh_lib.make_mesh(
-            {"tp": config.tp, "ep": config.ep, "dp": -1}, devices=devices
+            {"tp": config.tp, "ep": config.ep, "sp": config.sp, "dp": -1},
+            devices=devices,
         )
         if config.fused_attention:
             if self.mesh.devices.size != 1:
@@ -218,6 +223,7 @@ class TutoringEngine:
             )
         self.last_ttft_s: Optional[float] = None
         self.last_batch_ttfts: List[float] = []
+        self._score_fn = None  # built lazily on first score() call
 
     def _max_prompt_len(self) -> int:
         # Spec mode keeps its verify windows inside the position table:
@@ -304,6 +310,102 @@ class TutoringEngine:
             else:
                 result, _ = self._decode(self.params, state)
         return result if device_result else jax.device_get(result)
+
+    def score(self, texts: Sequence[str]) -> List[dict]:
+        """Log-likelihood scoring: per text, the total next-token log
+        probability, token count, and perplexity under the model.
+
+        Runs the FULL-SEQUENCE forward (no cache) — the long-context
+        direction: with `EngineConfig.sp > 1` the attention runs as ring
+        attention over sequence shards (parallel/ring.py), so documents
+        far beyond a single chip's attention budget score across the mesh.
+        Texts are right-padded to a power-of-two bucket (pads sit after
+        the causal horizon of every real token and are masked out of the
+        sum). Groups larger than the biggest batch bucket run as several
+        device batches. No reference counterpart — the reference cannot
+        evaluate model fit at all; this is what `bench`/gate-threshold
+        tuning and course-material relevance evals build on.
+
+        MoE caveat: with capacity dropping active (capacity_factor <
+        num_experts) a token's routing — hence its logprob — depends on
+        its forward-pass companions, pads and filler rows included
+        (models/moe.py). For reproducible MoE evals raise
+        capacity_factor to >= num_experts.
+        """
+        if not texts:
+            return []
+        cap = max(self.config.batch_buckets)
+        if len(texts) > cap:
+            out: List[dict] = []
+            for start in range(0, len(texts), cap):
+                out.extend(self.score(texts[start : start + cap]))
+            return out
+        limit = min(
+            max(self.config.length_buckets),
+            self.cfg.max_position_embeddings,
+        )
+        token_lists = []
+        for text in texts:
+            toks = self.tokenizer.encode(text)[:limit]
+            token_lists.append(toks if toks else [self.tokenizer.pad_id])
+        longest = max(len(t) for t in token_lists)
+        bucket = pick_bucket(longest, self.config.length_buckets)
+        bucket = min(bucket, limit)
+        if self.config.sp > 1:
+            # Ring attention consumes the sequence in sp equal shards.
+            bucket = ((bucket + self.config.sp - 1) // self.config.sp
+                      ) * self.config.sp
+        nbatch = pick_bucket(len(texts), self.config.batch_buckets)
+        if self.config.sp > 1:
+            # Ring attention shard_maps over the mesh: the batch must tile
+            # dp exactly (filler rows are all-pad, scored then dropped).
+            dp = self.mesh.shape.get("dp", 1)
+            nbatch = ((nbatch + dp - 1) // dp) * dp
+        ids = np.full((nbatch, bucket), self.tokenizer.pad_id, np.int32)
+        mask = np.zeros((nbatch, bucket), bool)
+        for i, toks in enumerate(token_lists):
+            ids[i, : len(toks)] = toks
+            mask[i, : len(toks)] = True
+
+        if self._score_fn is None:
+            import dataclasses as _dc
+
+            cfg = self.cfg
+            if self.config.sp > 1:
+                cfg = _dc.replace(cfg, ring_mesh=self.mesh)
+            family = self.family
+
+            def score_fn(params, ids, mask):
+                logits, *_ = family.forward(params, cfg, ids)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                          axis=-1)
+                picked = jnp.take_along_axis(
+                    logp[:, :-1], ids[:, 1:, None], axis=-1
+                )[..., 0]
+                valid = mask[:, 1:] & mask[:, :-1]
+                total = jnp.sum(
+                    jnp.where(valid, picked, 0.0), axis=1
+                )
+                count = jnp.sum(valid, axis=1)
+                return total, count
+
+            self._score_fn = jax.jit(score_fn)
+
+        with self.mesh:
+            total, count = jax.device_get(
+                self._score_fn(self.params, jnp.asarray(ids),
+                               jnp.asarray(mask))
+            )
+        out = []
+        for i in range(len(texts)):
+            n = int(count[i])
+            lp = float(total[i])
+            out.append({
+                "logprob": lp,
+                "tokens": n,
+                "ppl": float(np.exp(-lp / max(n, 1))),
+            })
+        return out
 
     def answer_batch(self, prompts: Sequence[str]) -> List[str]:
         """The serving entry: prompts in, decoded answers out.
