@@ -115,6 +115,12 @@ class BatchingQueue:
                 for i, _ in enumerate(group):
                     if i < len(ttfts):
                         self.metrics.hist("ttft").observe(ttfts[i])
+                tpw = getattr(self.engine, "last_spec_tokens_per_window",
+                              None)
+                if tpw is not None:
+                    # Speculation effectiveness: mean emitted tokens per
+                    # verify window (1.0 = nothing accepted).
+                    self.metrics.hist("spec_tokens_per_window").observe(tpw)
             for (_, fut), answer in zip(group, answers):
                 if not fut.done():
                     fut.set_result(answer)

@@ -223,6 +223,10 @@ class TutoringEngine:
             )
         self.last_ttft_s: Optional[float] = None
         self.last_batch_ttfts: List[float] = []
+        # Speculative-decoding observability: mean emitted tokens per
+        # verify window of the last generate (1.0 + acceptance; None until
+        # a spec generate ran). Fed to the server's metrics snapshot.
+        self.last_spec_tokens_per_window: Optional[float] = None
         self._score_fn = None  # built lazily on first score() call
 
     def _max_prompt_len(self) -> int:
@@ -306,7 +310,22 @@ class TutoringEngine:
             # state's same-shaped buffers (out/seen/rng/flags) alias into the
             # outputs; the cache intentionally grows instead — see decode().
             if self.config.spec_tokens > 0:
-                result, _ = self._decode(self.params, state, jnp.asarray(ids))
+                result, fin = self._decode(self.params, state,
+                                           jnp.asarray(ids))
+                if not device_result:
+                    # One extra scalar in the readback we do anyway. The
+                    # prefill-emitted token (one per row, no window ran
+                    # for it) is excluded: 1.0 = windows accepted nothing,
+                    # spec_tokens+1 = full acceptance. Rows finishing
+                    # early pull the mean below 1 (they emit 0 in later
+                    # windows) — the honest aggregate.
+                    windows = max(1, int(jax.device_get(fin.windows)))
+                    result = jax.device_get(result)
+                    self.last_spec_tokens_per_window = float(
+                        (np.sum(result.lengths) - len(ids))
+                        / (windows * len(ids))
+                    )
+                    return result
             else:
                 result, _ = self._decode(self.params, state)
         return result if device_result else jax.device_get(result)

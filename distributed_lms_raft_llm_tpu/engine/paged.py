@@ -264,6 +264,11 @@ class PagedEngine:
                 f"ep={config.ep} requires an MoE family; {config.model!r} "
                 f"has no expert axis to shard"
             )
+        if config.sp > 1:
+            raise ValueError(
+                "sp applies to TutoringEngine.score's ring-attention path; "
+                "the paged engine has no full-sequence forward to shard"
+            )
         self.mesh = mesh_lib.make_mesh(
             {"tp": config.tp, "ep": config.ep, "dp": -1}, devices=devices
         )

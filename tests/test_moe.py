@@ -359,6 +359,25 @@ class TestServing:
             np.asarray(ref.tokens), np.asarray(spec.tokens)
         )
 
+    def test_paged_engine_serves_moe(self):
+        # Continuous batching over the MoE family: per-slot ragged decode
+        # + the dispatch einsums under one chunked step program, with the
+        # expert stacks ep-sharded.
+        from distributed_lms_raft_llm_tpu.engine import (
+            EngineConfig,
+            PagedEngine,
+        )
+
+        eng = PagedEngine(EngineConfig(
+            model="moe-tiny",
+            sampling=SamplingParams.reference_defaults(max_new_tokens=8),
+            length_buckets=(16,), batch_buckets=(1, 2), ep=4,
+        ), slots=2, chunk=4)
+        assert eng.mesh.shape["ep"] == 4
+        rids = [eng.submit("what is a log?"), eng.submit("quorum?")]
+        out = eng.drain()
+        assert all(isinstance(out[r], str) for r in rids)
+
     def test_engine_rejects_ep_for_dense_family(self):
         from distributed_lms_raft_llm_tpu.engine import (
             EngineConfig,

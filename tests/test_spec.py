@@ -308,6 +308,23 @@ class TestEngineWiring:
         assert len(answers) == 2
         assert all(isinstance(a, str) for a in answers)
 
+    def test_engine_reports_tokens_per_window(self):
+        from distributed_lms_raft_llm_tpu.engine import (
+            EngineConfig,
+            TutoringEngine,
+        )
+
+        eng = TutoringEngine(EngineConfig(
+            model="tiny",
+            sampling=SamplingParams.greedy(max_new_tokens=12),
+            length_buckets=(16,), batch_buckets=(1,), spec_tokens=4,
+        ))
+        assert eng.last_spec_tokens_per_window is None
+        eng.answer_batch(["the the the the"])
+        tpw = eng.last_spec_tokens_per_window
+        # Prefill token excluded: the ceiling is exactly spec_tokens + 1.
+        assert tpw is not None and 0.0 < tpw <= 5.0
+
     def test_engine_rejects_spec_with_fused_attention(self):
         from distributed_lms_raft_llm_tpu.engine import (
             EngineConfig,
