@@ -119,8 +119,9 @@ class BatchingQueue:
                               None)
                 if tpw is not None:
                     # Speculation effectiveness: mean emitted tokens per
-                    # verify window (1.0 = nothing accepted).
-                    self.metrics.hist("spec_tokens_per_window").observe(tpw)
+                    # verify window (1.0 = nothing accepted). A gauge —
+                    # it is a ratio, not a latency.
+                    self.metrics.set_gauge("spec_tokens_per_window", tpw)
             for (_, fut), answer in zip(group, answers):
                 if not fut.done():
                     fut.set_result(answer)

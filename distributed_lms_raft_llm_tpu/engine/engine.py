@@ -286,6 +286,7 @@ class TutoringEngine:
         mask: np.ndarray,
         measure_ttft: bool = True,
         device_result: bool = False,
+        real_rows: Optional[int] = None,
     ) -> GenerateResult:
         """Generate for a pre-bucketed id batch; records measured TTFT.
 
@@ -318,12 +319,14 @@ class TutoringEngine:
                     # for it) is excluded: 1.0 = windows accepted nothing,
                     # spec_tokens+1 = full acceptance. Rows finishing
                     # early pull the mean below 1 (they emit 0 in later
-                    # windows) — the honest aggregate.
+                    # windows) — the honest aggregate. Only the first
+                    # `real_rows` count: batch-bucket filler rows'
+                    # degenerate speculation must not skew the reading.
+                    n = real_rows if real_rows is not None else len(ids)
                     windows = max(1, int(jax.device_get(fin.windows)))
                     result = jax.device_get(result)
                     self.last_spec_tokens_per_window = float(
-                        (np.sum(result.lengths) - len(ids))
-                        / (windows * len(ids))
+                        (np.sum(result.lengths[:n]) - n) / (windows * n)
                     )
                     return result
             else:
@@ -442,7 +445,7 @@ class TutoringEngine:
             chunk = prompts[start : start + cap]
             ids, mask, _ = self.encode_prompts(chunk)
             queued_s = time.monotonic() - t_submit
-            result = self.generate_ids(ids, mask)
+            result = self.generate_ids(ids, mask, real_rows=len(chunk))
             # Per-request TTFT counts from batch submission: requests in a
             # later device chunk also waited for every earlier chunk.
             ttfts.extend([queued_s + (self.last_ttft_s or 0.0)] * len(chunk))

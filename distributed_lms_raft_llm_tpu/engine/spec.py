@@ -338,11 +338,18 @@ def decode_spec(
         # are idempotent — same tokens, same slots); the position table
         # must not overflow while they idle.
         positions = jnp.minimum(positions, cfg.max_position_embeddings - 1)
+        # Batch 1 — the latency case speculation exists for — takes the
+        # scalar-offset cache path (dynamic_update_slice) instead of the
+        # per-row scatter; the window start is trivially uniform.
+        offs_len = t + base  # [B]
+        cache_in = s.cache._replace(
+            length=offs_len[0] if b == 1 else offs_len
+        )
         logits, cache2 = model.forward(
-            params, cfg, feed,
-            cache=s.cache._replace(length=t + base),
+            params, cfg, feed, cache=cache_in,
             positions=positions, kv_mask=s.kv_mask,
         )
+        cache2 = cache2._replace(length=offs_len)  # keep the carry [B]
         rng, r_win = jax.random.split(s.rng)
         emitted, valid, seen, hit_eos = verify_window(
             r_win, logits, drafts, s.seen, ~s.done, sampling, eos_id, pad_id

@@ -59,12 +59,20 @@ class LatencyHistogram:
 
 
 class Metrics:
-    """Named counters + histograms; one instance per server process."""
+    """Named counters + histograms + gauges; one per server process."""
 
     def __init__(self):
         self._counters: Dict[str, int] = {}
         self._hists: Dict[str, LatencyHistogram] = {}
+        self._gauges: Dict[str, float] = {}
         self._lock = threading.Lock()
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Last-value gauge for dimensionless readings (ratios, sizes) —
+        NOT latencies: histogram snapshots are rendered with seconds
+        suffixes, so a unitless value there reads as a bogus latency."""
+        with self._lock:
+            self._gauges[name] = float(value)
 
     def inc(self, name: str, amount: int = 1) -> None:
         with self._lock:
@@ -83,7 +91,11 @@ class Metrics:
         with self._lock:
             counters = dict(self._counters)
             hists = {k: h.snapshot() for k, h in self._hists.items()}
-        return {"counters": counters, "latency": hists}
+            gauges = dict(self._gauges)
+        out = {"counters": counters, "latency": hists}
+        if gauges:
+            out["gauges"] = gauges
+        return out
 
 
 class _Timer:
