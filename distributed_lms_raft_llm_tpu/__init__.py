@@ -10,16 +10,21 @@ the frozen `lms.proto` gRPC contract.
 Subpackages
 -----------
 - ``proto``    — frozen wire contract, generated messages, RPC glue
-- ``models``   — functional JAX models (GPT-2, BERT, Llama) as param
-  pytrees, HF conversion, weight-only int8 + int8-KV quantization
+- ``models``   — functional JAX models (GPT-2, BERT, Llama, Switch-style
+  GPT-2-MoE) as param pytrees, HF conversion, weight-only int8 +
+  int8-KV quantization (expert stacks included)
 - ``ops``      — Pallas TPU kernels (fused decode attention)
-- ``parallel`` — mesh, partition rules, ring attention (sp), pipeline (pp)
-- ``engine``   — inference runtime: KV cache, prefill/decode, group batching
-  and continuous batching (``paged``), sampling, relevance gate
-- ``train``    — sharded fine-tuning: data pipeline, train step,
-  checkpoint/resume, HF export
+- ``parallel`` — mesh, partition rules, ring attention (sp), pipeline
+  (pp), expert parallelism (ep)
+- ``engine``   — inference runtime: KV cache, prefill/decode, group
+  batching and continuous batching (``paged``), exact prompt-lookup
+  speculative decoding (``spec``), sampling, log-likelihood scoring,
+  relevance gate
+- ``train``    — sharded fine-tuning (dp/tp/sp/pp/ep): data pipeline,
+  train step with MoE aux loss, checkpoint/resume, HF export
 - ``raft``     — sans-IO Raft core + durable WAL + compaction/InstallSnapshot
-  + linearizable read barrier + gRPC/in-memory transports
+  + linearizable read barrier + runtime membership changes + leadership
+  transfer + gRPC/in-memory transports
 - ``lms``      — LMS state machine, appliers, persistence, file replication
 - ``serving``  — server entrypoints (lms_server, tutoring_server)
 - ``client``   — leader-discovering client library + terminal client + GUI

@@ -61,13 +61,19 @@ async def run(args) -> dict:
                      "merges_path": art["merges_path"]}
         if args.model == "gpt2":
             artifacts["checkpoint"] = art["checkpoint"]
+    sampling = (
+        SamplingParams.greedy(max_new_tokens=args.max_new_tokens)
+        if args.greedy
+        else SamplingParams.reference_defaults(
+            max_new_tokens=args.max_new_tokens
+        )
+    )
     config = EngineConfig(
         model=args.model,
-        sampling=SamplingParams.reference_defaults(
-            max_new_tokens=args.max_new_tokens
-        ),
+        sampling=sampling,
         quant=args.quant,
         kv_quant=args.kv_quant,
+        spec_tokens=args.spec_tokens,
         **artifacts,
     )
     if args.paged:
@@ -132,6 +138,11 @@ async def run(args) -> dict:
         "engine": "paged" if args.paged else "batched",
         "quant": args.quant or "bf16",
         "kv_quant": args.kv_quant,
+        "greedy": args.greedy,
+        "spec_tokens": args.spec_tokens,
+        "spec_tokens_per_window": snap.get("gauges", {}).get(
+            "spec_tokens_per_window"
+        ),
         "ttft_p90_ms": round(ttft.get("p90_s", 0.0) * 1000, 2),
         "ttft_count": ttft.get("count", 0),
         "answer_p50_s": round(answer_lat[n // 2], 3),
@@ -154,7 +165,16 @@ def main() -> None:
     ap.add_argument("--chunk", type=int, default=16)
     ap.add_argument("--quant", default=None, choices=["int8"])
     ap.add_argument("--kv-quant", action="store_true")
+    ap.add_argument("--greedy", action="store_true",
+                    help="temperature-0 sampling (the speculative serving "
+                         "configuration)")
+    ap.add_argument("--spec-tokens", type=int, default=0,
+                    help="speculative decoding draft window (group-batched "
+                         "engine; exact)")
     args = ap.parse_args()
+    if args.paged and args.spec_tokens:
+        ap.error("--spec-tokens applies to the group-batched engine; the "
+                 "paged engine decodes chunked single-token steps")
     print(json.dumps(asyncio.run(run(args))))
 
 
