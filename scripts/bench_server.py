@@ -9,6 +9,7 @@ end-to-end answer latency and aggregate throughput.
 
     python scripts/bench_server.py [--clients 8] [--queries 4] [--paged]
                                    [--quant int8] [--kv-quant]
+                                   [--greedy] [--spec-tokens 8]
 
 Prints ONE JSON line (same contract as bench.py).
 """
@@ -140,6 +141,9 @@ async def run(args) -> dict:
         "kv_quant": args.kv_quant,
         "greedy": args.greedy,
         "spec_tokens": args.spec_tokens,
+        # Last completed batch's mean (the gauge is last-value); batch
+        # counts here are small enough that it is representative, but it
+        # is a sample, not a run aggregate.
         "spec_tokens_per_window": snap.get("gauges", {}).get(
             "spec_tokens_per_window"
         ),
