@@ -13,7 +13,10 @@ unexplained. This script closes that gap:
    executes;
 3. writes profiles/decode_int8_r5_batch<B>.json.
 
-Usage: python scripts/profile_decode.py [--batch 8] [--bf16] [--out ...]
+Usage: python scripts/profile_decode.py [--batch 8] [--bf16]
+           [--greedy] [--spec-tokens 8] [--out ...]
+(--spec-tokens profiles the speculative verify-window loop of
+engine/spec.py instead of the plain 128-step while_loop decode.)
 
 (Methodology per BENCH_NOTES.md: `block_until_ready` does not sync on the
 axon backend — every timed region ends in a host readback.)
@@ -34,22 +37,24 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def build_engine(batch: int, quant: bool):
-    import jax.numpy as jnp
-
+def build_engine(batch: int, quant: bool, spec_tokens: int = 0,
+                 greedy: bool = False):
     from distributed_lms_raft_llm_tpu.engine import (
         EngineConfig, SamplingParams, TutoringEngine,
     )
 
     ckpt_dir = os.path.join(REPO, "data", "gpt2-local")
+    sampling = (SamplingParams.greedy(max_new_tokens=128) if greedy
+                else SamplingParams.reference_defaults(max_new_tokens=128))
     cfg = EngineConfig(
         model="gpt2",
         checkpoint=os.path.join(ckpt_dir, "model.safetensors"),
         vocab_path=os.path.join(ckpt_dir, "vocab.json"),
         merges_path=os.path.join(ckpt_dir, "merges.txt"),
-        sampling=SamplingParams.reference_defaults(max_new_tokens=128),
+        sampling=sampling,
         quant="int8" if quant else None,
         kv_quant=quant,
+        spec_tokens=spec_tokens,
         batch_buckets=(batch,),
         length_buckets=(64,),
     )
@@ -166,14 +171,32 @@ def main() -> None:
                     help="profile the bf16 config instead of int8+int8kv")
     ap.add_argument("--out", default=None)
     ap.add_argument("--trace-dir", default="/tmp/decode_trace")
+    ap.add_argument("--spec-tokens", type=int, default=0,
+                    help="profile the speculative decode path (pair with "
+                         "--greedy; engine/spec.py verify windows)")
+    ap.add_argument("--greedy", action="store_true")
     args = ap.parse_args()
 
     import jax
     import numpy as np
 
-    eng = build_engine(args.batch, quant=not args.bf16)
-    ids = np.zeros((args.batch, 64), np.int32)
-    mask = np.ones((args.batch, 64), bool)
+    eng = build_engine(args.batch, quant=not args.bf16,
+                       spec_tokens=args.spec_tokens,
+                       greedy=args.greedy)
+    if args.spec_tokens:
+        # A REAL prompt: an all-zeros one is 64 repeated tokens, which
+        # prompt-lookup drafting predicts near-perfectly — the profile
+        # would show best-case window counts, not representative ones.
+        prompt = (
+            "You are an intelligent assistant. Answer the following "
+            "question clearly and concisely.\nQuestion: Explain how "
+            "leader election works in the Raft consensus algorithm and "
+            "why a quorum is needed.\nAnswer:"
+        )
+        ids, mask, _ = eng.encode_prompts([prompt] * args.batch)
+    else:
+        ids = np.zeros((args.batch, 64), np.int32)
+        mask = np.ones((args.batch, 64), bool)
     eng.generate_ids(ids, mask)  # compile + warm
     import shutil
 
@@ -193,7 +216,10 @@ def main() -> None:
             eng.params, input_ids=jnp.asarray(ids),
             prompt_mask=jnp.asarray(mask), rng=jax.random.key(0),
         )
-        lowered = eng._decode.lower(eng.params, state)
+        if args.spec_tokens:
+            lowered = eng._decode.lower(eng.params, state, jnp.asarray(ids))
+        else:
+            lowered = eng._decode.lower(eng.params, state)
         hlo = lowered.compile().as_text()
     bodies = fusion_bodies(hlo)
 
@@ -203,13 +229,17 @@ def main() -> None:
             row["hlo"] = bodies[base]
 
     label = "bf16" if args.bf16 else "int8w_int8kv"
+    if args.greedy:
+        label += "_greedy"
+    if args.spec_tokens:
+        label += f"_spec{args.spec_tokens}"
     out_path = args.out or os.path.join(
         REPO, "profiles", f"decode_{label}_r5_batch{args.batch}.json"
     )
     payload = {
         "description": (
             f"Device-time breakdown of ONE generate_ids call (64-token "
-            f"prompt prefill + 128-step decode), GPT-2-small batch "
+            f"prompt prefill + decode to 128 tokens), GPT-2-small batch "
             f"{args.batch}, {label}; fusions annotated with their "
             f"fused-computation opcode histograms from the optimized HLO "
             f"of the decode program"
