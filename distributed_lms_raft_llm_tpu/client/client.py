@@ -6,11 +6,21 @@ follow redirects to the named leader, and on transient RPC failures
 re-resolve the leader and retry (≤3). Reimplemented as a clean synchronous
 library the CLI/GUI layers (and tests) share, with channel reuse instead of
 per-call dialing.
+
+Retry semantics (utils/resilience.py): every logical operation runs under
+ONE overall `Deadline` — created here, propagated to the server as the gRPC
+timeout plus an explicit budget header, decremented across redirects and
+retries. Transient failures back off with full jitter instead of the
+reference's immediate-retry hammering (a synchronized retry herd is what
+turns a leader blip into an outage), and the loop stops the moment the
+budget is gone — the caller gets its answer or its error within the
+deadline, never a hang.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import time
 import uuid
 from typing import Callable, Dict, List, Optional, Sequence, TypeVar
@@ -18,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Sequence, TypeVar
 import grpc
 
 from ..proto import lms_pb2, rpc
+from ..utils.resilience import Deadline, DeadlineExpired, jittered_backoff
 
 log = logging.getLogger(__name__)
 
@@ -45,12 +56,24 @@ class LMSClient:
         discovery_backoff_s: float = 1.0,
         rpc_retries: int = 3,
         rpc_timeout: float = 30.0,
+        request_timeout_s: float = 60.0,
+        llm_timeout_s: float = 120.0,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        seed: Optional[int] = None,
     ):
         self.servers = list(servers)
         self.discovery_rounds = discovery_rounds
         self.discovery_backoff_s = discovery_backoff_s
         self.rpc_retries = rpc_retries
         self.rpc_timeout = rpc_timeout
+        # Overall budgets: one Deadline bounds discovery + all retries of a
+        # logical op. ask_llm gets its own (generation is the slow path).
+        self.request_timeout_s = request_timeout_s
+        self.llm_timeout_s = llm_timeout_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._rng = random.Random(seed)
         self.token: Optional[str] = None
         self.role: Optional[str] = None
         self._channels: Dict[str, grpc.Channel] = {}
@@ -74,29 +97,63 @@ class LMSClient:
             ch.close()
         self._channels.clear()
 
-    def discover_leader(self, force: bool = False) -> str:
-        """Address of the current leader (cached until an RPC fails)."""
+    def discover_leader(
+        self, force: bool = False, deadline: Optional[Deadline] = None
+    ) -> str:
+        """Address of the current leader (cached until an RPC fails).
+
+        Bounded by `deadline` when given: discovery gives up the moment the
+        caller's budget is gone instead of finishing its sweep schedule.
+        """
         if self._leader_addr and not force:
             return self._leader_addr
         for attempt in range(self.discovery_rounds):
             for addr in self.servers:
+                if deadline is not None and deadline.expired:
+                    raise NoLeader(
+                        f"no leader found among {self.servers} within budget"
+                    )
                 try:
+                    probe_timeout = 2.0
+                    if deadline is not None:
+                        probe_timeout = max(0.1, deadline.timeout(cap=2.0))
                     stub = rpc.RaftServiceStub(self._channel(addr))
-                    resp = stub.GetLeader(lms_pb2.GetLeaderRequest(), timeout=2)
+                    resp = stub.GetLeader(
+                        lms_pb2.GetLeaderRequest(), timeout=probe_timeout
+                    )
                     if resp.nodeId > 0 and resp.nodeAddress:
                         self._leader_addr = resp.nodeAddress
                         return self._leader_addr
-                    who = stub.WhoIsLeader(lms_pb2.Empty(), timeout=2)
+                    who = stub.WhoIsLeader(lms_pb2.Empty(), timeout=probe_timeout)
                     if 0 < who.leader_id <= len(self.servers):
                         self._leader_addr = self.servers[who.leader_id - 1]
                         return self._leader_addr
                 except grpc.RpcError:
                     continue
-            time.sleep(self.discovery_backoff_s)
+            sleep_s = jittered_backoff(
+                attempt, base_s=self.discovery_backoff_s,
+                cap_s=self.discovery_backoff_s * 4, rng=self._rng,
+            )
+            if deadline is not None:
+                if deadline.expired:
+                    break
+                sleep_s = min(sleep_s, deadline.remaining())
+            time.sleep(sleep_s)
         raise NoLeader(f"no leader found among {self.servers}")
 
-    def _call(self, fn: Callable[[rpc.LMSStub], T]) -> T:
-        """Run an op against the leader; re-resolve + retry on transients.
+    def _call(
+        self,
+        fn: Callable[[rpc.LMSStub, float, Optional[Deadline]], T],
+        *,
+        budget_s: Optional[float] = None,
+        attempt_cap_s: Optional[float] = -1.0,
+    ) -> T:
+        """Run an op against the leader under one overall deadline.
+
+        `fn(stub, timeout, deadline)` performs the RPC with the given
+        per-attempt timeout (the remaining budget capped at rpc_timeout).
+        Transient failures re-resolve the leader and retry with jittered
+        exponential backoff until the retry count or the budget runs out.
 
         Mutating callers bake a `request_id` into the request (see
         `_request_id`): the SAME id is re-sent on every retry, so if the
@@ -104,41 +161,69 @@ class LMSClient:
         waiting for the quorum ACK), the replicated applier drops the
         duplicate instead of double-applying a non-idempotent command.
         """
+        deadline = Deadline.after(budget_s or self.request_timeout_s)
+        # -1 sentinel: default to the per-attempt rpc_timeout cap; None
+        # means "let one attempt use the whole remaining budget" (ask_llm,
+        # where generation legitimately outlasts control-plane RPCs).
+        cap = self.rpc_timeout if attempt_cap_s == -1.0 else attempt_cap_s
         last_error: Optional[Exception] = None
         for attempt in range(self.rpc_retries + 1):
+            if deadline.expired:
+                break
             try:
-                addr = self.discover_leader(force=attempt > 0)
+                addr = self.discover_leader(force=attempt > 0, deadline=deadline)
                 stub = rpc.LMSStub(self._channel(addr))
-                return fn(stub)
+                timeout = max(0.001, deadline.timeout(cap=cap))
+                return fn(stub, timeout, deadline)
             except grpc.RpcError as e:
                 last_error = e
                 if e.code() not in RETRYABLE:
                     raise
                 log.info("rpc failed (%s); re-resolving leader", e.code())
-        raise last_error  # type: ignore[misc]
+                if attempt >= self.rpc_retries:
+                    break  # out of attempts: fail now, don't sleep first
+                sleep_s = min(
+                    jittered_backoff(
+                        attempt, base_s=self.backoff_base_s,
+                        cap_s=self.backoff_max_s, rng=self._rng,
+                    ),
+                    deadline.remaining(),
+                )
+                if sleep_s > 0:
+                    time.sleep(sleep_s)
+        if last_error is not None:
+            raise last_error
+        raise DeadlineExpired(
+            f"request budget ({budget_s or self.request_timeout_s:.1f}s) "
+            "exhausted before the first attempt"
+        )
 
     @staticmethod
     def _request_id() -> str:
         """Idempotency key for one logical mutation (stable across retries)."""
         return uuid.uuid4().hex
 
+    @staticmethod
+    def _md(deadline: Optional[Deadline]):
+        return deadline.to_metadata() if deadline is not None else None
+
     # ----------------------------------------------------------------- api
 
     def register(self, username: str, password: str, role: str):
         return self._call(
-            lambda s: s.Register(
+            lambda s, t, d: s.Register(
                 lms_pb2.RegisterRequest(
                     username=username, password=password, role=role
                 ),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         )
 
     def login(self, username: str, password: str) -> bool:
         resp = self._call(
-            lambda s: s.Login(
+            lambda s, t, d: s.Login(
                 lms_pb2.LoginRequest(username=username, password=password),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         )
         if resp.success:
@@ -150,8 +235,9 @@ class LMSClient:
         if not self.token:
             return False
         resp = self._call(
-            lambda s: s.Logout(
-                lms_pb2.LogoutRequest(token=self.token), timeout=self.rpc_timeout
+            lambda s, t, d: s.Logout(
+                lms_pb2.LogoutRequest(token=self.token), timeout=t,
+                metadata=self._md(d),
             )
         )
         if resp.success:
@@ -162,53 +248,53 @@ class LMSClient:
     def upload_assignment(self, filename: str, content: bytes) -> bool:
         rid = self._request_id()
         return self._call(
-            lambda s: s.Post(
+            lambda s, t, d: s.Post(
                 lms_pb2.PostRequest(
                     token=self.token or "", type="assignment",
                     file=content, filename=filename, request_id=rid,
                 ),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         ).success
 
     def upload_course_material(self, filename: str, content: bytes) -> bool:
         rid = self._request_id()
         return self._call(
-            lambda s: s.Post(
+            lambda s, t, d: s.Post(
                 lms_pb2.PostRequest(
                     token=self.token or "", type="course_material",
                     file=content, filename=filename, request_id=rid,
                 ),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         ).success
 
     def ask_instructor(self, query: str) -> bool:
         rid = self._request_id()
         return self._call(
-            lambda s: s.Post(
+            lambda s, t, d: s.Post(
                 lms_pb2.PostRequest(
                     token=self.token or "", type="query", data=query,
                     request_id=rid,
                 ),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         ).success
 
     def course_materials(self) -> List[lms_pb2.DataEntry]:
         resp = self._call(
-            lambda s: s.Get(
+            lambda s, t, d: s.Get(
                 lms_pb2.GetRequest(token=self.token or "", type="course_material"),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         )
         return list(resp.entries)
 
     def student_assignments(self) -> List[lms_pb2.DataEntry]:
         resp = self._call(
-            lambda s: s.Get(
+            lambda s, t, d: s.Get(
                 lms_pb2.GetRequest(token=self.token or "", type="student_list"),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         )
         return list(resp.entries)
@@ -216,29 +302,29 @@ class LMSClient:
     def grade(self, student: str, grade: str):
         rid = self._request_id()
         return self._call(
-            lambda s: s.GradeAssignment(
+            lambda s, t, d: s.GradeAssignment(
                 lms_pb2.GradeRequest(
                     token=self.token or "", studentId=student, grade=grade,
                     request_id=rid,
                 ),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         )
 
     def my_grade(self) -> str:
         resp = self._call(
-            lambda s: s.GetGrade(
+            lambda s, t, d: s.GetGrade(
                 lms_pb2.GetGradeRequest(token=self.token or ""),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         )
         return resp.grade
 
     def unanswered_queries(self) -> List[lms_pb2.DataEntry]:
         resp = self._call(
-            lambda s: s.GetUnansweredQueries(
+            lambda s, t, d: s.GetUnansweredQueries(
                 lms_pb2.GetRequest(token=self.token or ""),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         )
         return list(resp.entries)
@@ -246,28 +332,36 @@ class LMSClient:
     def respond_to_query(self, student: str, response: str) -> bool:
         rid = self._request_id()
         return self._call(
-            lambda s: s.RespondToQuery(
+            lambda s, t, d: s.RespondToQuery(
                 lms_pb2.PostRequest(
                     token=self.token or "", studentId=student, data=response,
                     request_id=rid,
                 ),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         ).success
 
     def instructor_responses(self) -> List[lms_pb2.DataEntry]:
         resp = self._call(
-            lambda s: s.GetInstructorResponse(
+            lambda s, t, d: s.GetInstructorResponse(
                 lms_pb2.GetRequest(token=self.token or ""),
-                timeout=self.rpc_timeout,
+                timeout=t, metadata=self._md(d),
             )
         )
         return list(resp.entries)
 
-    def ask_llm(self, query: str) -> lms_pb2.QueryResponse:
+    def ask_llm(
+        self, query: str, *, budget_s: Optional[float] = None
+    ) -> lms_pb2.QueryResponse:
+        """One student query under one overall budget (default
+        `llm_timeout_s`). The LMS forwards the remaining budget to the
+        tutoring node; if tutoring is down or too slow the LMS answers
+        degraded (query queued for an instructor) within the budget."""
         return self._call(
-            lambda s: s.GetLLMAnswer(
+            lambda s, t, d: s.GetLLMAnswer(
                 lms_pb2.QueryRequest(token=self.token or "", query=query),
-                timeout=max(self.rpc_timeout, 120.0),
-            )
+                timeout=t, metadata=self._md(d),
+            ),
+            budget_s=budget_s or self.llm_timeout_s,
+            attempt_cap_s=None,
         )

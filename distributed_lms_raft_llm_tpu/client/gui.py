@@ -507,12 +507,16 @@ def main(argv=None) -> None:
                              "the server list")
     args = parser.parse_args(argv)
     servers = args.servers.split(",")
+    client_opts = {}
     # Explicit --servers beats the file (same precedence as the servers).
-    if args.config and args.servers == parser.get_default("servers"):
-        from ..config import load_config
+    if args.config:
+        from ..config import client_kwargs, load_config
 
-        servers = load_config(args.config).client_servers
-    client = LMSClient(servers)
+        cfg = load_config(args.config)
+        if args.servers == parser.get_default("servers"):
+            servers = cfg.client_servers
+        client_opts = client_kwargs(cfg)
+    client = LMSClient(servers, **client_opts)
     try:
         client.discover_leader()
     except NoLeader as e:

@@ -22,7 +22,11 @@ a full reference-topology example.
 from __future__ import annotations
 
 import dataclasses
-import tomllib
+
+try:
+    import tomllib  # Python >= 3.11
+except ImportError:  # pragma: no cover - version-dependent
+    import tomli as tomllib  # type: ignore[no-redef]
 from typing import Any, Dict, List, Optional
 
 
@@ -94,11 +98,43 @@ class GateConfig:
 
 
 @dataclasses.dataclass
+class ResilienceConfig:
+    """[resilience] — overload & failure behavior of the query path.
+
+    One section because the knobs only make sense together: the client's
+    overall budget bounds every retry; the LMS forwards the *remaining*
+    budget to tutoring (keeping `deadline_floor_s` headroom for the
+    degraded fallback); tutoring sheds queue-expired work and bounds
+    admission at `queue_depth`; the breaker turns a dead tutoring node
+    into O(1) degraded answers instead of stacked timeouts.
+    """
+
+    # Client side (client/client.py).
+    request_timeout_s: float = 60.0   # overall budget per logical op
+    llm_timeout_s: float = 120.0      # overall budget for ask_llm
+    backoff_base_s: float = 0.05      # full-jitter exponential backoff
+    backoff_max_s: float = 2.0
+    # LMS → tutoring hop (lms/service.py).
+    tutoring_timeout_s: float = 120.0  # cap when the client sent no budget
+    deadline_floor_s: float = 0.25     # below this, degrade instead of forward
+    breaker_failure_threshold: int = 5
+    breaker_recovery_s: float = 10.0
+    breaker_half_open_max: int = 1
+    # Tutoring admission (engine/batcher.py); 0 = unbounded.
+    queue_depth: int = 64
+    # utils/faults.py seed for the chaos admin plane.
+    fault_seed: int = 0
+
+
+@dataclasses.dataclass
 class AppConfig:
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     tutoring: TutoringConfig = dataclasses.field(default_factory=TutoringConfig)
     sampling: SamplingConfig = dataclasses.field(default_factory=SamplingConfig)
     gate: GateConfig = dataclasses.field(default_factory=GateConfig)
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig
+    )
 
     @property
     def client_servers(self) -> List[str]:
@@ -120,7 +156,8 @@ def load_config(path: str) -> AppConfig:
     """Parse a TOML deployment file into an AppConfig (strict keys)."""
     with open(path, "rb") as fh:
         raw = tomllib.load(fh)
-    unknown = set(raw) - {"cluster", "tutoring", "sampling", "gate"}
+    unknown = set(raw) - {"cluster", "tutoring", "sampling", "gate",
+                          "resilience"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -137,6 +174,8 @@ def load_config(path: str) -> AppConfig:
         sampling=_build(SamplingConfig, dict(raw.get("sampling", {})),
                         "sampling"),
         gate=_build(GateConfig, dict(raw.get("gate", {})), "gate"),
+        resilience=_build(ResilienceConfig, dict(raw.get("resilience", {})),
+                          "resilience"),
     )
 
 
@@ -180,6 +219,17 @@ def apply_file_defaults(
     for name, value in overrides.items():
         if getattr(probe, name, _UNSET) is _UNSET:
             setattr(args, name, value)
+
+
+def client_kwargs(cfg: AppConfig) -> Dict[str, Any]:
+    """LMSClient constructor kwargs from [resilience]."""
+    r = cfg.resilience
+    return dict(
+        request_timeout_s=r.request_timeout_s,
+        llm_timeout_s=r.llm_timeout_s,
+        backoff_base_s=r.backoff_base_s,
+        backoff_max_s=r.backoff_max_s,
+    )
 
 
 def sampling_params(cfg: AppConfig):

@@ -10,6 +10,14 @@ sharded generate program (batch bucketed to powers of two in the engine).
 The reference handles concurrency with a 10-thread pool and sequential
 model.generate calls (tutoring_server.py:40) — throughput 1/latency. Here
 throughput scales with the batch bucket until the chip saturates.
+
+Overload behavior (both queues): admission is bounded — `max_queue` waiting
+requests, beyond which `submit()` raises `Overloaded` (the server maps it
+to RESOURCE_EXHAUSTED, the wire's backpressure signal) instead of growing
+an unbounded backlog whose tail nobody is still waiting for. Requests may
+carry a `Deadline`; one that expires while queued is dropped *before* its
+prefill is dispatched (counter `shed_expired`), so a saturated chip only
+computes answers that can still be delivered.
 """
 
 from __future__ import annotations
@@ -19,7 +27,12 @@ import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
+
 log = logging.getLogger(__name__)
+
+# Queue items: (prompt, deadline-or-None, result future).
+_Item = Tuple[str, Optional[Deadline], asyncio.Future]
 
 
 class BatchingQueue:
@@ -31,14 +44,26 @@ class BatchingQueue:
         max_batch: int = 8,
         max_wait_ms: float = 10.0,
         metrics=None,
+        max_queue: int = 0,
     ):
         self.engine = engine
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
         self.metrics = metrics
-        self._queue: asyncio.Queue[Tuple[str, asyncio.Future]] = asyncio.Queue()
+        self.max_queue = max_queue  # 0 = unbounded (legacy behavior)
+        self._queue: asyncio.Queue[_Item] = asyncio.Queue()
         self._runner: Optional[asyncio.Task] = None
         self._closed = False
+
+    def _inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    @property
+    def waiting(self) -> int:
+        """Requests admitted but not yet in a device batch — what the
+        `max_queue` bound is enforced against (healthz reports it)."""
+        return self._queue.qsize()
 
     async def start(self) -> None:
         if self._runner is None:
@@ -56,19 +81,33 @@ class BatchingQueue:
         # Fail fast for anything still waiting (queued requests, or a group
         # whose device batch was cancelled mid-flight) instead of hanging.
         while not self._queue.empty():
-            _, fut = self._queue.get_nowait()
+            _, _, fut = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(RuntimeError("batching queue closed"))
 
-    async def submit(self, prompt: str) -> str:
-        """Enqueue one query; resolves with its decoded answer."""
+    async def submit(self, prompt: str,
+                     deadline: Optional[Deadline] = None) -> str:
+        """Enqueue one query; resolves with its decoded answer.
+
+        Raises `Overloaded` when the bounded queue is full and
+        `DeadlineExpired` when the budget is already gone — both *before*
+        the request occupies a queue slot.
+        """
         if self._closed:
             raise RuntimeError("batching queue is closed")
+        if deadline is not None and deadline.expired:
+            self._inc("shed_expired")
+            raise DeadlineExpired("expired before enqueue")
+        if self.max_queue and self._queue.qsize() >= self.max_queue:
+            self._inc("shed_overload")
+            raise Overloaded(
+                f"tutoring queue full ({self._queue.qsize()} waiting)"
+            )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((prompt, fut))
+        await self._queue.put((prompt, deadline, fut))
         return await fut
 
-    async def _collect(self) -> List[Tuple[str, asyncio.Future]]:
+    async def _collect(self) -> List[_Item]:
         """Block for the first request, then gather companions briefly."""
         first = await self._queue.get()
         group = [first]
@@ -84,26 +123,46 @@ class BatchingQueue:
                 break
         return group
 
+    def _drop_expired(self, group: List[_Item]) -> List[_Item]:
+        """Shed queue-expired requests BEFORE their prefill dispatches:
+        computing an answer whose client has already given up wastes the
+        exact device time an overloaded server is short of."""
+        live: List[_Item] = []
+        for item in group:
+            _, dl, fut = item
+            if dl is not None and dl.expired:
+                self._inc("shed_expired")
+                if not fut.done():
+                    fut.set_exception(
+                        DeadlineExpired("expired while queued; prefill skipped")
+                    )
+            else:
+                live.append(item)
+        return live
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            group = await self._collect()
-            prompts = [p for p, _ in group]
+            group = self._drop_expired(await self._collect())
+            if not group:
+                continue  # everything expired while queued: zero prefills
+            prompts = [p for p, _, _ in group]
             try:
                 # The engine call blocks on device compute; run it off-loop so
                 # new requests keep queueing meanwhile.
+                self._inc("engine_batches")
                 answers = await loop.run_in_executor(
                     None, self.engine.answer_batch, prompts
                 )
             except asyncio.CancelledError:
                 # close() mid-batch: resolve the in-flight group before dying.
-                for _, fut in group:
+                for _, _, fut in group:
                     if not fut.done():
                         fut.set_exception(RuntimeError("batching queue closed"))
                 raise
             except Exception as e:  # resolve all waiters with the failure
                 log.exception("batch of %d failed", len(prompts))
-                for _, fut in group:
+                for _, _, fut in group:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
@@ -122,7 +181,7 @@ class BatchingQueue:
                     # verify window (1.0 = nothing accepted). A gauge —
                     # it is a ratio, not a latency.
                     self.metrics.set_gauge("spec_tokens_per_window", tpw)
-            for (_, fut), answer in zip(group, answers):
+            for (_, _, fut), answer in zip(group, answers):
                 if not fut.done():
                     fut.set_result(answer)
 
@@ -139,13 +198,30 @@ class PagedQueue:
     reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
     """
 
-    def __init__(self, engine, metrics=None):
+    def __init__(self, engine, metrics=None, max_queue: int = 0):
         self.engine = engine
         self.metrics = metrics
-        self._incoming: asyncio.Queue[Tuple[str, asyncio.Future]] = asyncio.Queue()
+        self.max_queue = max_queue  # bound on not-yet-admitted requests
+        self._incoming: asyncio.Queue[_Item] = asyncio.Queue()
         self._futures: Dict[int, asyncio.Future] = {}
+        # rid -> deadline for requests sitting in the ENGINE's pending list
+        # (handed over by _admit but no slot yet — prefill hasn't run).
+        self._pending_deadlines: Dict[int, Deadline] = {}
         self._runner: Optional[asyncio.Task] = None
         self._closed = False
+
+    @property
+    def waiting(self) -> int:
+        """Requests admitted nowhere yet: queued here plus backlogged in
+        the engine (the runner drains _incoming eagerly, so the engine's
+        pre-slot pending list is where the real backlog accumulates).
+        The `max_queue` bound is enforced against this; healthz reports
+        it."""
+        return self._incoming.qsize() + getattr(self.engine, "backlog", 0)
+
+    def _inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
 
     async def start(self) -> None:
         if self._runner is None:
@@ -161,35 +237,88 @@ class PagedQueue:
                 pass
             self._runner = None
         while not self._incoming.empty():
-            _, fut = self._incoming.get_nowait()
+            _, _, fut = self._incoming.get_nowait()
             if not fut.done():
                 fut.set_exception(RuntimeError("paged queue closed"))
         for fut in self._futures.values():
             if not fut.done():
                 fut.set_exception(RuntimeError("paged queue closed"))
         self._futures.clear()
+        self._pending_deadlines.clear()
 
-    async def submit(self, prompt: str) -> str:
+    async def submit(self, prompt: str,
+                     deadline: Optional[Deadline] = None) -> str:
         if self._closed:
             raise RuntimeError("paged queue is closed")
+        if deadline is not None and deadline.expired:
+            self._inc("shed_expired")
+            raise DeadlineExpired("expired before enqueue")
+        if self.max_queue and self.waiting >= self.max_queue:
+            self._inc("shed_overload")
+            raise Overloaded(
+                f"paged admission queue full ({self.waiting} waiting)"
+            )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._incoming.put((prompt, fut))
+        await self._incoming.put((prompt, deadline, fut))
         return await fut
+
+    def _admit(self, prompt: str, deadline: Optional[Deadline],
+               fut: asyncio.Future) -> None:
+        # Shed before prefill: a queue-expired request never enters the
+        # engine (its prefill chunk is the expensive step).
+        if deadline is not None and deadline.expired:
+            self._inc("shed_expired")
+            if not fut.done():
+                fut.set_exception(
+                    DeadlineExpired("expired while queued; prefill skipped")
+                )
+            return
+        rid = self.engine.submit(prompt)
+        self._futures[rid] = fut
+        if deadline is not None:
+            self._pending_deadlines[rid] = deadline
 
     def _drain_incoming(self) -> None:
         while not self._incoming.empty():
-            prompt, fut = self._incoming.get_nowait()
-            self._futures[self.engine.submit(prompt)] = fut
+            prompt, deadline, fut = self._incoming.get_nowait()
+            self._admit(prompt, deadline, fut)
+
+    def _shed_expired_pending(self) -> None:
+        """Requests that expired while backlogged in the engine's pending
+        list are cancelled BEFORE the next step admits them to a slot —
+        their prefill never dispatches. Once a request holds a slot its
+        deadline stops mattering (the compute is already committed)."""
+        if not self._pending_deadlines:
+            return
+        cancel = getattr(self.engine, "cancel_pending", None)
+        for rid, dl in list(self._pending_deadlines.items()):
+            if not dl.expired:
+                continue
+            if cancel is not None and cancel(rid):
+                self._pending_deadlines.pop(rid, None)
+                fut = self._futures.pop(rid, None)
+                self._inc("shed_expired")
+                if fut is not None and not fut.done():
+                    fut.set_exception(DeadlineExpired(
+                        "expired while backlogged; prefill skipped"
+                    ))
+            else:
+                # Already in a slot (or the engine can't cancel): stop
+                # tracking, the answer will resolve normally.
+                self._pending_deadlines.pop(rid, None)
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
             # Idle: block until a request arrives, then admit it plus any
             # companions that queued behind it.
-            prompt, fut = await self._incoming.get()
-            self._futures[self.engine.submit(prompt)] = fut
+            prompt, deadline, fut = await self._incoming.get()
+            self._admit(prompt, deadline, fut)
             while self.engine.has_work:
                 self._drain_incoming()
+                self._shed_expired_pending()
+                if not self.engine.has_work:
+                    break  # everything backlogged expired; nothing to step
                 try:
                     # step() blocks on device compute; run off-loop so new
                     # submissions keep landing in _incoming meanwhile.
@@ -202,6 +331,7 @@ class PagedQueue:
                         if not f.done():
                             f.set_exception(e)
                     self._futures.clear()
+                    self._pending_deadlines.clear()
                     # A failed step may have donated the live state away;
                     # rebuild it or every later request fails too.
                     self.engine.reset()
@@ -211,6 +341,7 @@ class PagedQueue:
                     for ttft in ttfts.values():
                         self.metrics.hist("ttft").observe(ttft)
                 for rid, text in done:
+                    self._pending_deadlines.pop(rid, None)
                     f = self._futures.pop(rid, None)
                     if f is not None and not f.done():
                         f.set_result(text)

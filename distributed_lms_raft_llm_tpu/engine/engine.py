@@ -226,8 +226,29 @@ class TutoringEngine:
         # Speculative-decoding observability: mean emitted tokens per
         # verify window of the last generate (1.0 + acceptance; None until
         # a spec generate ran). Fed to the server's metrics snapshot.
-        self.last_spec_tokens_per_window: Optional[float] = None
+        # device_result=True generates stash their device scalars here and
+        # the property resolves them lazily — the pipelined dispatch path
+        # never blocks on a readback, yet the gauge still updates.
+        self._pending_spec_stats = None
+        self._last_spec_tpw: Optional[float] = None
         self._score_fn = None  # built lazily on first score() call
+
+    @property
+    def last_spec_tokens_per_window(self) -> Optional[float]:
+        if self._pending_spec_stats is not None:
+            windows, lengths, n = self._pending_spec_stats
+            self._pending_spec_stats = None
+            w = max(1, int(jax.device_get(windows)))
+            lengths = np.asarray(jax.device_get(lengths))
+            self._last_spec_tpw = float(
+                (np.sum(lengths[:n]) - n) / (w * n)
+            )
+        return self._last_spec_tpw
+
+    @last_spec_tokens_per_window.setter
+    def last_spec_tokens_per_window(self, value: Optional[float]) -> None:
+        self._pending_spec_stats = None
+        self._last_spec_tpw = value
 
     def _max_prompt_len(self) -> int:
         # Spec mode keeps its verify windows inside the position table:
@@ -273,7 +294,12 @@ class TutoringEngine:
 
     def warmup(self, batch: int = 8, bucket: Optional[int] = None) -> float:
         """Pre-compile the hot program; returns compile seconds."""
-        bucket = bucket or self.config.length_buckets[0]
+        # Cap like encode_prompts does: live traffic never exceeds
+        # _max_prompt_len(), and an uncapped warmup bucket would trip
+        # decode_spec's position-budget validation (spec mode with a small
+        # position table) on a shape real requests can't reach.
+        bucket = min(bucket or self.config.length_buckets[0],
+                     self._max_prompt_len())
         t0 = time.monotonic()
         ids = np.zeros((batch, bucket), np.int32)
         mask = np.ones((batch, bucket), bool)
@@ -313,6 +339,7 @@ class TutoringEngine:
             if self.config.spec_tokens > 0:
                 result, fin = self._decode(self.params, state,
                                            jnp.asarray(ids))
+                n = real_rows if real_rows is not None else len(ids)
                 if not device_result:
                     # One extra scalar in the readback we do anyway. The
                     # prefill-emitted token (one per row, no window ran
@@ -322,13 +349,16 @@ class TutoringEngine:
                     # windows) — the honest aggregate. Only the first
                     # `real_rows` count: batch-bucket filler rows'
                     # degenerate speculation must not skew the reading.
-                    n = real_rows if real_rows is not None else len(ids)
                     windows = max(1, int(jax.device_get(fin.windows)))
                     result = jax.device_get(result)
                     self.last_spec_tokens_per_window = float(
                         (np.sum(result.lengths[:n]) - n) / (windows * n)
                     )
                     return result
+                # Pipelined path: no blocking readback here — defer the
+                # gauge math to the property's next access, by which point
+                # the computation has long finished.
+                self._pending_spec_stats = (fin.windows, result.lengths, n)
             else:
                 result, _ = self._decode(self.params, state)
         return result if device_result else jax.device_get(result)
@@ -366,6 +396,12 @@ class TutoringEngine:
             max(self.config.length_buckets),
             self.cfg.max_position_embeddings,
         )
+        if self.config.sp > 1:
+            # The bucket below is rounded UP to a multiple of sp; floor the
+            # limit to a multiple first so the rounded bucket can never
+            # exceed the position table (JAX would clamp the wpe gather
+            # silently and score garbage positions).
+            limit = (limit // self.config.sp) * self.config.sp
         token_lists = []
         for text in texts:
             toks = self.tokenizer.encode(text)[:limit]
@@ -374,9 +410,14 @@ class TutoringEngine:
         bucket = pick_bucket(longest, self.config.length_buckets)
         bucket = min(bucket, limit)
         if self.config.sp > 1:
-            # Ring attention consumes the sequence in sp equal shards.
-            bucket = ((bucket + self.config.sp - 1) // self.config.sp
-                      ) * self.config.sp
+            # Ring attention consumes the sequence in sp equal shards; the
+            # sp-floored `limit` above guarantees this stays <= the
+            # position table.
+            bucket = min(
+                ((bucket + self.config.sp - 1) // self.config.sp
+                 ) * self.config.sp,
+                limit,
+            )
         nbatch = pick_bucket(len(texts), self.config.batch_buckets)
         if self.config.sp > 1:
             # Ring attention shard_maps over the mesh: the batch must tile

@@ -379,6 +379,23 @@ class PagedEngine:
         self._pending.append(req)
         return req.rid
 
+    @property
+    def backlog(self) -> int:
+        """Requests submitted but not yet admitted to a decode slot (their
+        prefill has not run). The serving queue counts these toward its
+        admission bound."""
+        return len(self._pending)
+
+    def cancel_pending(self, rid: int) -> bool:
+        """Remove a not-yet-admitted request; True if it was still pending.
+        Its prefill never runs. A request already in a slot is not
+        cancellable (its compute is already committed)."""
+        for i, req in enumerate(self._pending):
+            if req.rid == rid:
+                del self._pending[i]
+                return True
+        return False
+
     def warmup(self) -> float:
         """Compile the serving program set so no live request pays an XLA
         compile: the step program at every cache width, each prompt

@@ -264,6 +264,18 @@ def decode_spec(
     max_new = sampling.max_new_tokens
     b, t = input_ids.shape
     width = t + max_new + k - 1
+    # Position-budget validation (mirrors prefill's t + max_new <= mpe
+    # guard, extended by the spec window's k-1 overhang): a direct caller
+    # that oversubscribes the position table gets an error here, not
+    # silently-clamped (wrong) position embeddings near the end of
+    # generation. The in-loop clamp below remains ONLY for idle done-rows
+    # re-verifying their final window.
+    if width > cfg.max_position_embeddings:
+        raise ValueError(
+            f"verify-window budget exceeds the position table: prompt {t} "
+            f"+ max_new_tokens {max_new} + spec_tokens {k} - 1 = {width} "
+            f"> max_position_embeddings {cfg.max_position_embeddings}"
+        )
 
     prompt_valid = state.kv_mask[:, :t]
     cache = _grow_cache(state.cache, width)

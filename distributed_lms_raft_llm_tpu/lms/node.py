@@ -41,6 +41,7 @@ class LMSNode:
         raft_config: Optional[RaftConfig] = None,
         transport=None,
         snapshot_every: int = 64,
+        fault_injector=None,
     ):
         # snapshot_every > 1 amortizes the full-state JSON rewrite (the WAL
         # already guarantees durability; on crash, at most snapshot_every
@@ -57,6 +58,12 @@ class LMSNode:
 
         storage = FileStorage(os.path.join(data_dir, "raft_wal.jsonl"))
         transport = transport or GrpcTransport(self.addresses)
+        if fault_injector is not None:
+            # Chaos over real sockets: per-peer drop/delay/error/duplicate
+            # on the live Raft egress, driven by the admin endpoint.
+            from ..utils.faults import FaultyTransport
+
+            transport = FaultyTransport(transport, fault_injector)
         self.node = RaftNode(
             node_id,
             # id -> address mapping seeds raft membership; a durable

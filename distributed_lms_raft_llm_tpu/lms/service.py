@@ -37,7 +37,9 @@ from ..proto import lms_pb2, rpc
 from ..raft import NotLeader, TransferInFlight, encode_command
 from ..utils import pdf
 from ..utils.auth import sign_query
+from ..utils.faults import FaultInjected, FaultInjector
 from ..utils.metrics import Metrics
+from ..utils.resilience import CircuitBreaker, Deadline
 from .persistence import BlobStore
 from .state import LMSState, hash_password
 
@@ -60,6 +62,10 @@ class LMSServicer(rpc.LMSServicer):
         peer_addresses: Optional[Dict[int, str]] = None,
         self_id: Optional[int] = None,
         linearizable_reads: bool = True,
+        tutoring_breaker: Optional[CircuitBreaker] = None,
+        fault_injector: Optional[FaultInjector] = None,
+        tutoring_timeout_s: float = 120.0,
+        deadline_floor_s: float = 0.25,
     ):
         self.node = node
         self.state = state
@@ -71,6 +77,19 @@ class LMSServicer(rpc.LMSServicer):
         self._tutoring_auth_key = tutoring_auth_key
         self._tutoring_channel: Optional[grpc.aio.Channel] = None
         self._tutoring_stub = None
+        # Resilience around the tutoring forward: the breaker turns a dead
+        # tutoring node into O(1) degraded answers (instructor queue)
+        # instead of per-request stacked timeouts; the injector lets chaos
+        # tests fault this hop over real gRPC (admin: POST /admin/faults).
+        # The servicer owns the transition observer either way — callers
+        # supply thresholds, not logging/metrics plumbing.
+        self.tutoring_breaker = tutoring_breaker or CircuitBreaker()
+        self.tutoring_breaker.set_state_change_callback(
+            self._on_breaker_change
+        )
+        self.faults = fault_injector
+        self._tutoring_timeout_s = tutoring_timeout_s
+        self._deadline_floor_s = deadline_floor_s
         # Peer map for blob anti-entropy (fetch-on-miss); empty = disabled.
         # Kept as a LIVE reference (no copy): the caller passes the same
         # mapping runtime membership changes mutate (LMSNode.addresses), so
@@ -138,6 +157,45 @@ class LMSServicer(rpc.LMSServicer):
             )
             self._tutoring_stub = rpc.TutoringStub(self._tutoring_channel)
         return self._tutoring_stub
+
+    def _on_breaker_change(self, old: str, new: str) -> None:
+        log.warning("tutoring breaker %s -> %s", old, new)
+        self.metrics.inc(f"tutoring_breaker_{new}")
+        self.metrics.set_gauge(
+            "tutoring_breaker_state", CircuitBreaker._STATE_CODES[new]
+        )
+
+    async def _degraded_answer(self, username: str, query: str, reason: str):
+        """Tutoring unusable (breaker open / budget gone / RPC failed):
+        fall back to the reference's human path — replicate the query onto
+        the instructor queue and tell the student so. The answer degrades;
+        the request never hangs or errors while the cluster is otherwise
+        healthy."""
+        self.metrics.inc("tutoring_degraded")
+        log.warning("tutoring degraded (%s); queueing for instructor", reason)
+        try:
+            await self.node.propose(
+                encode_command(
+                    "AskQuery",
+                    {"username": username, "query": query,
+                     "request_id": uuid.uuid4().hex},
+                )
+            )
+        except (NotLeader, TransferInFlight, TimeoutError, RuntimeError) as e:
+            # Can't even commit the fallback (lost leadership mid-request):
+            # tell the client to retry rather than fake success.
+            log.warning("degraded fallback propose failed: %s", e)
+            return lms_pb2.QueryResponse(
+                success=False,
+                response="The tutoring service is unavailable and your "
+                "query could not be queued; please retry.",
+            )
+        return lms_pb2.QueryResponse(
+            success=True,
+            response="The LLM tutor is currently unavailable, so your "
+            "question was forwarded to an instructor. Check "
+            "'instructor responses' later for the answer.",
+        )
 
     async def _blob(self, rel_path: str) -> bytes:
         """Blob bytes for committed metadata; fetch-on-miss from peers.
@@ -485,6 +543,26 @@ class LMSServicer(rpc.LMSServicer):
                 return lms_pb2.QueryResponse(
                     success=False, response="Tutoring service not configured."
                 )
+            # Deadline propagation: the client's remaining budget (gRPC
+            # deadline and/or metadata header) bounds the tutoring hop,
+            # minus a floor of headroom so the degraded fallback can still
+            # commit before the client gives up.
+            deadline = Deadline.from_grpc_context(context)
+            budget = (
+                deadline.timeout(cap=self._tutoring_timeout_s)
+                if deadline is not None
+                else self._tutoring_timeout_s
+            )
+            if deadline is not None and budget <= self._deadline_floor_s:
+                self.metrics.inc("tutoring_budget_exhausted")
+                return await self._degraded_answer(
+                    username, request.query, "deadline budget exhausted"
+                )
+            if not self.tutoring_breaker.allow():
+                self.metrics.inc("tutoring_breaker_rejections")
+                return await self._degraded_answer(
+                    username, request.query, "circuit open"
+                )
             # With a shared key configured, the forwarded query carries an
             # HMAC ticket in the token field; the tutoring node answers only
             # ticketed queries, closing the direct-dial gate bypass.
@@ -494,15 +572,32 @@ class LMSServicer(rpc.LMSServicer):
                 else request.token
             )
             try:
+                plan = (await self.faults.apply_pre("tutoring")
+                        if self.faults is not None else None)
+                if deadline is not None:
+                    # Re-read the live budget: an injected delay (or any
+                    # await above) has been eating it since the snapshot,
+                    # and the forward's timeout must not overshoot what
+                    # the client will actually wait.
+                    budget = deadline.timeout(cap=self._tutoring_timeout_s)
                 answer = await stub.GetLLMAnswer(
                     lms_pb2.QueryRequest(token=fwd_token, query=request.query),
-                    timeout=120,
+                    timeout=max(0.001, budget - self._deadline_floor_s)
+                    if deadline is not None else budget,
+                    metadata=(deadline.to_metadata()
+                              if deadline is not None else None),
                 )
-            except grpc.RpcError as e:
-                log.warning("tutoring RPC failed: %s", e)
-                return lms_pb2.QueryResponse(
-                    success=False, response="The tutoring service is unavailable."
+                if plan is not None and plan.error:
+                    raise FaultInjected("injected response loss <- tutoring")
+            except (grpc.RpcError, FaultInjected) as e:
+                code = e.code() if isinstance(e, grpc.RpcError) else None
+                log.warning("tutoring RPC failed: %s", code or e)
+                self.metrics.inc("tutoring_failures")
+                self.tutoring_breaker.record_failure()
+                return await self._degraded_answer(
+                    username, request.query, f"tutoring RPC failed ({code or e})"
                 )
+            self.tutoring_breaker.record_success()
         return answer
 
     async def WhoIsLeader(self, request, context):

@@ -164,8 +164,11 @@ class RaftCore:
         # appends from the abdicating leader must not demote the candidate
         # (the pre-vote mechanism keeps current_term at the OLD term until
         # the first grant, so the old leader's in-flight heartbeats would
-        # otherwise cancel the sanctioned campaign).
+        # otherwise cancel the sanctioned campaign). Appends from any
+        # OTHER leader of an equal term are a different story — see
+        # on_append_request — so the abdicator's id is remembered.
         self._transfer_campaign_deadline = float("-inf")
+        self._transfer_abdicating_leader: Optional[int] = None
 
         # (peer_id, message) pairs for the runner to deliver.
         self.outbox: List[Tuple[int, object]] = []
@@ -346,6 +349,8 @@ class RaftCore:
             now + self.config.election_timeout_min if transfer
             else float("-inf")
         )
+        if not transfer:
+            self._transfer_abdicating_leader = None
         self._reset_election_timer(now)
         req = VoteRequest(
             term=self._proposed_term,
@@ -527,12 +532,18 @@ class RaftCore:
         if (
             self.role is Role.CANDIDATE
             and now < self._transfer_campaign_deadline
+            and req.leader_id == self._transfer_abdicating_leader
         ):
             # Transfer campaign in progress: the equal-term append is the
             # ABDICATING leader's in-flight traffic — don't let it cancel
             # the campaign it sanctioned. Reject without demoting; the old
             # leader steps down on seeing our proposed term, and if the
             # campaign fails the election timer recovers normally.
+            # An equal-term append from any OTHER leader (one legitimately
+            # elected for a term we adopted mid-campaign) falls through to
+            # the step-down below: our campaign for that term is already
+            # lost, and refusing its appends would only stall convergence
+            # by up to an election timeout.
             return AppendResponse(
                 term=self.current_term,
                 success=False,
@@ -712,6 +723,9 @@ class RaftCore:
         if req.term >= self.current_term and not self.removed:
             self.leader_id = None
             self.start_election(now, transfer=True)
+            # Only THIS leader's in-flight appends may be rejected without
+            # demoting us during the campaign window.
+            self._transfer_abdicating_leader = req.leader_id
         return TimeoutNowResponse(term=self.current_term)
 
     def on_timeout_now_response(

@@ -35,7 +35,9 @@ from ..lms.service import FileTransferServicer, LMSServicer
 from ..proto import rpc
 from ..raft import RaftConfig
 from ..raft.grpc_transport import RaftServicer
+from ..utils.faults import FaultInjector
 from ..utils.metrics import Metrics
+from ..utils.resilience import CircuitBreaker
 
 log = logging.getLogger("lms_server")
 
@@ -57,9 +59,13 @@ async def serve_async(args) -> None:
         election_timeout_max=args.election_timeout,
         heartbeat_interval=args.heartbeat_interval,
     )
+    # One injector per node shapes BOTH fault surfaces (Raft egress and the
+    # tutoring forward); dormant (zero overhead beyond a dict probe) until
+    # the admin endpoint installs a spec.
+    faults = FaultInjector(seed=args.fault_seed)
     lms_node = LMSNode(
         args.id, addresses, args.data_dir, raft_config=raft_config,
-        snapshot_every=args.snapshot_every,
+        snapshot_every=args.snapshot_every, fault_injector=faults,
     )
 
     gate = None
@@ -80,6 +86,12 @@ async def serve_async(args) -> None:
             tutoring_auth_key = fh.read().strip()
 
     metrics = Metrics()
+    # Thresholds only; the servicer wires the log/metrics observer itself.
+    breaker = CircuitBreaker(
+        failure_threshold=args.breaker_threshold,
+        recovery_s=args.breaker_recovery,
+        half_open_max=args.breaker_half_open,
+    )
     servicer = LMSServicer(
         lms_node.node,
         lms_node.state,
@@ -93,6 +105,10 @@ async def serve_async(args) -> None:
         peer_addresses=lms_node.addresses,
         self_id=args.id,
         linearizable_reads=args.linearizable_reads,
+        tutoring_breaker=breaker,
+        fault_injector=faults,
+        tutoring_timeout_s=args.tutoring_timeout,
+        deadline_floor_s=args.deadline_floor,
     )
     server = grpc.aio.server(
         options=[
@@ -118,8 +134,22 @@ async def serve_async(args) -> None:
         POST /admin/transfer {"target": N?} — graceful leadership handoff
         (thesis §3.10: drain to the most caught-up member before planned
         maintenance; resolves once this node has stepped down).
+        POST /admin/faults — chaos over real gRPC (utils/faults.py):
+        {"target": "raft:2"|"tutoring"|"*", "drop": 0.3, "error": 0.1,
+        "delay_s": 0.05, "delay_jitter_s": 0.05, "duplicate": 0.1} installs
+        a spec; {"clear": "raft:2"} removes one; {"reset": true} removes
+        all; {} reads the current state.
         The admin plane rides the local HTTP endpoint, keeping the gRPC
         wire contract frozen."""
+        if path == "/admin/faults":
+            if body.get("reset"):
+                faults.clear()
+            elif "clear" in body:
+                faults.clear(str(body["clear"]))
+            elif "target" in body:
+                spec = {k: v for k, v in body.items() if k != "target"}
+                faults.configure(str(body["target"]), **spec)
+            return {"ok": True, "faults": faults.snapshot()}
         if path == "/admin/transfer":
             target = body.get("target")
             chosen = await lms_node.node.transfer_leadership(
@@ -166,6 +196,10 @@ async def serve_async(args) -> None:
                 "members": {
                     str(k): v for k, v in lms_node.node.core.members.items()
                 },
+                # Resilience surface: operators see shed/degrade pressure
+                # here without scraping /metrics.
+                "tutoring_breaker": breaker.snapshot(),
+                "faults": faults.snapshot(),
             },
             admin=admin,
             port=args.metrics_port,
@@ -227,6 +261,24 @@ def main(argv=None) -> None:
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
                              "ephemeral); omit to disable")
+    parser.add_argument("--breaker-threshold", type=int, default=5,
+                        help="consecutive tutoring failures that open the "
+                             "circuit (degraded instructor-queue answers)")
+    parser.add_argument("--breaker-recovery", type=float, default=10.0,
+                        help="seconds the tutoring circuit stays open "
+                             "before a half-open probe")
+    parser.add_argument("--breaker-half-open", type=int, default=1,
+                        help="concurrent probe calls allowed while "
+                             "half-open")
+    parser.add_argument("--tutoring-timeout", type=float, default=120.0,
+                        help="cap on the tutoring forward when the client "
+                             "sent no deadline")
+    parser.add_argument("--deadline-floor", type=float, default=0.25,
+                        help="remaining-budget floor below which the LMS "
+                             "degrades instead of forwarding to tutoring")
+    parser.add_argument("--fault-seed", type=int, default=0,
+                        help="seed for the /admin/faults chaos injector "
+                             "(deterministic fault replay)")
     parser.add_argument("--no-linearizable-reads", action="store_true",
                         help="serve reads from local state without the "
                              "leadership fence (the reference's behavior)")
@@ -263,6 +315,12 @@ def main(argv=None) -> None:
             "heartbeat_interval": cfg.cluster.heartbeat_interval,
             "metrics_period": cfg.cluster.metrics_period,
             "snapshot_every": cfg.cluster.snapshot_every,
+            "breaker_threshold": cfg.resilience.breaker_failure_threshold,
+            "breaker_recovery": cfg.resilience.breaker_recovery_s,
+            "breaker_half_open": cfg.resilience.breaker_half_open_max,
+            "tutoring_timeout": cfg.resilience.tutoring_timeout_s,
+            "deadline_floor": cfg.resilience.deadline_floor_s,
+            "fault_seed": cfg.resilience.fault_seed,
         }, argv=argv)
         if not args.no_linearizable_reads:
             args.linearizable_reads = cfg.cluster.linearizable_reads

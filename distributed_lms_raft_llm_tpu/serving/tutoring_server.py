@@ -37,6 +37,7 @@ from ..engine import (
 from ..proto import lms_pb2, rpc
 from ..utils import auth
 from ..utils.metrics import Metrics
+from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
 
 log = logging.getLogger("tutoring_server")
 
@@ -70,12 +71,28 @@ class TutoringService(rpc.TutoringServicer):
             )
         if not request.query.strip():
             return lms_pb2.QueryResponse(success=False, response="Empty query.")
+        # The caller's remaining budget rides in on the gRPC deadline (and/or
+        # the explicit metadata header); thread it into the batcher so a
+        # request that expires while queued is shed before its prefill.
+        deadline = Deadline.from_grpc_context(context)
+        if deadline is not None and deadline.expired:
+            self.metrics.inc("shed_expired")
+            await context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                "deadline already expired on arrival",
+            )
         prompt = PROMPT_TEMPLATE.format(query=request.query)
         try:
             # Full-answer latency for this RPC; the "ttft" histogram is fed
             # by the batcher from the engine's measured first-token time.
             with self.metrics.time("answer_latency"):
-                answer = await self.queue.submit(prompt)
+                answer = await self.queue.submit(prompt, deadline=deadline)
+        except Overloaded as e:
+            # The wire's backpressure signal: clients back off and retry,
+            # the LMS breaker counts it toward opening.
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except DeadlineExpired as e:
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception:
             log.exception("generation failed")
             self.metrics.inc("llm_failures")
@@ -97,6 +114,7 @@ async def serve_async(
     *,
     max_batch: int = 8,
     max_wait_ms: float = 10.0,
+    max_queue: int = 0,
     metrics: Optional[Metrics] = None,
     metrics_period_s: float = 60.0,
     auth_key: Optional[str] = None,
@@ -107,13 +125,16 @@ async def serve_async(
     `engine` is a `TutoringEngine` (group-batched generate) or a
     `PagedEngine` (continuous batching: requests join the running batch
     mid-decode); the matching queue front-end is picked automatically.
+    `max_queue` bounds waiting requests (0 = unbounded): beyond it new
+    RPCs are refused with RESOURCE_EXHAUSTED instead of queueing forever.
     """
     metrics = metrics or Metrics()
     if isinstance(engine, PagedEngine):
-        queue = PagedQueue(engine, metrics=metrics)
+        queue = PagedQueue(engine, metrics=metrics, max_queue=max_queue)
     else:
         queue = BatchingQueue(engine, max_batch=max_batch,
-                              max_wait_ms=max_wait_ms, metrics=metrics)
+                              max_wait_ms=max_wait_ms, metrics=metrics,
+                              max_queue=max_queue)
     await queue.start()
     server = grpc.aio.server(
         options=[
@@ -139,7 +160,16 @@ async def serve_async(
 
         server._health = HealthServer(
             metrics,
-            health=lambda: {"ok": True, "engine": type(engine).__name__},
+            health=lambda: {
+                "ok": True,
+                "engine": type(engine).__name__,
+                # Admission pressure at a glance (details in /metrics:
+                # shed_overload / shed_expired / engine_batches). `waiting`
+                # is what the bound is enforced against — for the paged
+                # queue that includes the engine's pre-slot backlog.
+                "queue_depth_limit": max_queue,
+                "queued": queue.waiting,
+            },
             port=metrics_port,
         )
         bound = await server._health.start()
@@ -188,6 +218,11 @@ def main(argv=None) -> None:
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--max-wait-ms", type=float, default=10.0)
     parser.add_argument(
+        "--queue-depth", type=int, default=64,
+        help="bounded admission: waiting requests beyond this are refused "
+        "with RESOURCE_EXHAUSTED (0 = unbounded)",
+    )
+    parser.add_argument(
         "--paged", action="store_true",
         help="continuous batching: requests join the running batch "
         "mid-decode instead of waiting for the current group",
@@ -223,6 +258,7 @@ def main(argv=None) -> None:
             "ep": t.ep,
             "quant": t.quant, "max_new_tokens": s.max_new_tokens,
             "max_batch": t.max_batch, "max_wait_ms": t.max_wait_ms,
+            "queue_depth": cfg.resilience.queue_depth,
             "slots": t.slots, "chunk": t.chunk,
             "auth_key_file": t.auth_key_file,
             # store_true flags merge the same way: presence in argv is what
@@ -294,7 +330,8 @@ def main(argv=None) -> None:
     async def run():
         server = await serve_async(
             args.port, engine, max_batch=args.max_batch,
-            max_wait_ms=args.max_wait_ms, auth_key=auth_key,
+            max_wait_ms=args.max_wait_ms, max_queue=args.queue_depth,
+            auth_key=auth_key,
             metrics_port=args.metrics_port,
         )
         await server.wait_for_termination()

@@ -1,0 +1,175 @@
+"""Seeded fault injection for the real gRPC paths.
+
+The chaos tests used to live exclusively on `raft.node.MemNetwork` — an
+in-process transport whose drop/partition hooks never exercise the actual
+sockets, codecs, or timeout plumbing. `FaultInjector` moves the same
+fault surface onto the wire: a seeded RNG decides, per *target* (a Raft
+peer, or the LMS→tutoring hop), whether a send is dropped, delayed,
+errored after delivery (response lost), or duplicated.
+
+Targets are plain strings — `"raft:3"` for Raft traffic to peer 3,
+`"tutoring"` for the LMS→tutoring forward, `"*"` as a wildcard fallback —
+so one injector instance can shape an entire node's egress. Specs are
+mutable at runtime: the LMS admin endpoint (`POST /admin/faults`) toggles
+them over HTTP, which is how the chaos-over-real-gRPC soak drives a live
+cluster.
+
+Determinism: one `random.Random(seed)` per injector; with a fixed seed and
+a fixed call sequence the same faults fire, so soak failures replay.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+import threading
+from typing import Dict, Optional
+
+from ..raft.node import Transport
+
+
+class FaultInjected(ConnectionError):
+    """An injected transport failure (callers treat it like a network
+    error: retry/degrade, never crash)."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """Per-target fault probabilities (all default to 'no fault')."""
+
+    drop: float = 0.0        # P(request lost before delivery)
+    error: float = 0.0       # P(response lost after delivery)
+    delay_s: float = 0.0     # fixed added latency
+    delay_jitter_s: float = 0.0  # + uniform[0, jitter)
+    duplicate: float = 0.0   # P(request delivered twice)
+
+    def clamped(self) -> "FaultSpec":
+        return FaultSpec(
+            drop=min(1.0, max(0.0, self.drop)),
+            error=min(1.0, max(0.0, self.error)),
+            delay_s=max(0.0, self.delay_s),
+            delay_jitter_s=max(0.0, self.delay_jitter_s),
+            duplicate=min(1.0, max(0.0, self.duplicate)),
+        )
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """The sampled decisions for one send."""
+
+    drop: bool = False
+    error: bool = False
+    delay_s: float = 0.0
+    duplicate: bool = False
+
+    @property
+    def any(self) -> bool:
+        return self.drop or self.error or self.duplicate or self.delay_s > 0
+
+
+class FaultInjector:
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._specs: Dict[str, FaultSpec] = {}
+        self._lock = threading.Lock()
+        self._injected = 0
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return bool(self._specs)
+
+    def configure(self, target: str, **kwargs) -> FaultSpec:
+        """Set (replace) the spec for `target`; unknown keys raise so admin
+        typos surface as HTTP 400 rather than silent no-ops."""
+        known = {f.name for f in dataclasses.fields(FaultSpec)}
+        bad = set(kwargs) - known
+        if bad:
+            raise ValueError(f"unknown fault field(s) {sorted(bad)} "
+                             f"(known: {sorted(known)})")
+        spec = FaultSpec(**{k: float(v) for k, v in kwargs.items()}).clamped()
+        with self._lock:
+            self._specs[target] = spec
+        return spec
+
+    def clear(self, target: Optional[str] = None) -> None:
+        with self._lock:
+            if target is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(target, None)
+
+    def spec_for(self, target: str) -> Optional[FaultSpec]:
+        with self._lock:
+            return self._specs.get(target) or self._specs.get("*")
+
+    def plan(self, target: str) -> FaultPlan:
+        """Sample this send's faults (single RNG; lock keeps the stream
+        coherent under concurrent sends)."""
+        spec = self.spec_for(target)
+        if spec is None:
+            return FaultPlan()
+        with self._lock:
+            plan = FaultPlan(
+                drop=self._rng.random() < spec.drop,
+                error=self._rng.random() < spec.error,
+                delay_s=spec.delay_s
+                + (self._rng.random() * spec.delay_jitter_s
+                   if spec.delay_jitter_s else 0.0),
+                duplicate=self._rng.random() < spec.duplicate,
+            )
+            if plan.drop or plan.error or plan.duplicate:
+                self._injected += 1
+            return plan
+
+    async def apply_pre(self, target: str) -> FaultPlan:
+        """Sample + apply the pre-delivery faults (delay, drop); returns
+        the plan so the caller can apply post-delivery faults too."""
+        plan = self.plan(target)
+        if plan.delay_s > 0:
+            await asyncio.sleep(plan.delay_s)
+        if plan.drop:
+            raise FaultInjected(f"injected drop -> {target}")
+        return plan
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "injected_total": self._injected,
+                "targets": {
+                    t: dataclasses.asdict(s) for t, s in self._specs.items()
+                },
+            }
+
+
+class FaultyTransport(Transport):
+    """Wraps a real transport (normally `raft.grpc_transport.GrpcTransport`)
+    with the injector. Target keys are `"<prefix>:<peer_id>"` so Raft
+    traffic to individual peers can be shaped independently."""
+
+    def __init__(self, inner: Transport, injector: FaultInjector,
+                 prefix: str = "raft"):
+        self.inner = inner
+        self.injector = injector
+        self.prefix = prefix
+
+    @property
+    def addresses(self):
+        # RaftNode syncs membership addresses into `transport.addresses`;
+        # forward to the wrapped transport's live map.
+        return getattr(self.inner, "addresses", None)
+
+    async def send(self, peer: int, message):
+        plan = await self.injector.apply_pre(f"{self.prefix}:{peer}")
+        resp = await self.inner.send(peer, message)
+        if plan.duplicate:
+            # The peer processes the message twice (Raft RPCs are
+            # idempotent by design — this verifies it over real sockets).
+            resp = await self.inner.send(peer, message)
+        if plan.error:
+            raise FaultInjected(f"injected response loss <- {self.prefix}:{peer}")
+        return resp
+
+    async def close(self) -> None:
+        await self.inner.close()

@@ -1,0 +1,297 @@
+"""Resilience primitives for the student-query path.
+
+Every hop of that path (client → LMS leader → tutoring node → batcher →
+device) previously had its own ad-hoc timeout and an immediate-retry loop;
+an overloaded or half-dead cluster therefore burned TPU time computing
+answers nobody was still waiting for — the classic tail-latency failure
+mode ("The Tail at Scale", Dean & Barroso 2013). This module centralizes
+the three mechanisms that beat raw speed at scale:
+
+- `Deadline`: one request-scoped time budget, created where the request
+  enters the system and *decremented at each hop* (encoded as the gRPC
+  timeout, so `context.time_remaining()` recovers it server-side, plus an
+  explicit metadata header for non-gRPC hops). Work whose budget is gone
+  is shed *before* the expensive step, not after.
+- `jittered_backoff`: full-jitter exponential backoff for retry loops
+  (synchronized immediate retries from thousands of clients are what turn
+  a blip into an outage).
+- `CircuitBreaker`: closed → open → half-open around a dependency; when
+  the dependency is down, callers fail over to the degraded path in O(1)
+  instead of stacking timeouts.
+
+Everything takes an injectable `clock` so the state machines are testable
+without sleeping.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+# Metadata key carrying the remaining budget in milliseconds. A *relative*
+# budget (not an absolute timestamp) survives clock skew between hosts; each
+# hop re-anchors it against its own monotonic clock on receipt.
+DEADLINE_METADATA_KEY = "x-deadline-budget-ms"
+
+
+class Overloaded(Exception):
+    """Admission refused: a bounded queue is full (maps to
+    RESOURCE_EXHAUSTED on the wire)."""
+
+
+class DeadlineExpired(Exception):
+    """The request's time budget ran out (maps to DEADLINE_EXCEEDED)."""
+
+
+class BreakerOpen(Exception):
+    """The circuit breaker is open; the dependency is presumed down."""
+
+
+class Deadline:
+    """An absolute point on a monotonic clock; the request's total budget.
+
+    Created once at the edge (`Deadline.after(seconds)`); every later hop
+    asks `remaining()` / `timeout(cap=...)` for its slice and refuses work
+    when `expired`.
+    """
+
+    __slots__ = ("_deadline", "_clock")
+
+    def __init__(self, deadline: float, *, clock: Callable[[], float] = time.monotonic):
+        self._deadline = float(deadline)
+        self._clock = clock
+
+    @classmethod
+    def after(cls, budget_s: float, *,
+              clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls(clock() + max(0.0, float(budget_s)), clock=clock)
+
+    def remaining(self) -> float:
+        """Seconds left; never negative."""
+        return max(0.0, self._deadline - self._clock())
+
+    @property
+    def expired(self) -> bool:
+        return self._clock() >= self._deadline
+
+    def timeout(self, cap: Optional[float] = None) -> float:
+        """The per-attempt gRPC timeout for the next hop: the remaining
+        budget, optionally capped (a hop must not consume the whole budget
+        when the caller wants headroom for a fallback)."""
+        rem = self.remaining()
+        return rem if cap is None else min(rem, float(cap))
+
+    def raise_if_expired(self, what: str = "request") -> None:
+        if self.expired:
+            raise DeadlineExpired(f"{what}: deadline expired")
+
+    # ------------------------------------------------------------- encoding
+
+    def to_metadata(self) -> List[Tuple[str, str]]:
+        return [(DEADLINE_METADATA_KEY, str(int(self.remaining() * 1000.0)))]
+
+    @classmethod
+    def from_metadata(
+        cls, metadata, *, clock: Callable[[], float] = time.monotonic
+    ) -> Optional["Deadline"]:
+        """Decode the budget header from a gRPC metadata sequence (pairs or
+        a mapping); None when absent or malformed."""
+        if metadata is None:
+            return None
+        items = metadata.items() if hasattr(metadata, "items") else metadata
+        for key, value in items:
+            if key == DEADLINE_METADATA_KEY:
+                try:
+                    return cls.after(int(value) / 1000.0, clock=clock)
+                except (TypeError, ValueError):
+                    return None
+        return None
+
+    @classmethod
+    def from_grpc_context(
+        cls, context, *, clock: Callable[[], float] = time.monotonic
+    ) -> Optional["Deadline"]:
+        """Recover the caller's budget server-side: the tighter of the
+        native gRPC deadline (`context.time_remaining()`, propagated from
+        the client's `timeout=`) and the explicit metadata header. None
+        when the caller set neither (an unbounded request)."""
+        budgets = []
+        try:
+            rem = context.time_remaining()
+        except Exception:
+            rem = None
+        # grpc returns None (sync) or a huge float (aio uses None too) for
+        # no-deadline calls; guard the nonsensical as well.
+        if rem is not None and rem == rem and rem < 1e9:
+            budgets.append(max(0.0, rem))
+        try:
+            md = context.invocation_metadata()
+        except Exception:
+            md = None
+        from_md = cls.from_metadata(md, clock=clock)
+        if from_md is not None:
+            budgets.append(from_md.remaining())
+        if not budgets:
+            return None
+        return cls.after(min(budgets), clock=clock)
+
+
+def jittered_backoff(
+    attempt: int,
+    *,
+    base_s: float = 0.05,
+    factor: float = 2.0,
+    cap_s: float = 2.0,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Full-jitter exponential backoff: uniform in [0, min(cap, base·f^n)].
+
+    Full jitter (vs. equal jitter) maximally decorrelates a retry herd —
+    the property that matters when every student client re-resolves the
+    same dead leader at once.
+    """
+    ceiling = min(float(cap_s), float(base_s) * float(factor) ** max(0, attempt))
+    r = rng.random() if rng is not None else random.random()
+    return r * ceiling
+
+
+class CircuitBreaker:
+    """Closed / open / half-open breaker around one dependency.
+
+    - CLOSED: calls flow; `failure_threshold` *consecutive* failures open
+      the circuit.
+    - OPEN: `allow()` is False until `recovery_s` has elapsed, then the
+      breaker moves to HALF_OPEN.
+    - HALF_OPEN: up to `half_open_max` probe calls are allowed; one success
+      closes the circuit, one failure re-opens it (and restarts the
+      recovery clock).
+
+    Thread-safe; the asyncio servers share one instance per dependency.
+    `on_state_change(old, new)` lets callers mirror the state into metrics.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+    _STATE_CODES = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 5,
+        recovery_s: float = 10.0,
+        half_open_max: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        on_state_change: Optional[Callable[[str, str], None]] = None,
+    ):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.recovery_s = recovery_s
+        self.half_open_max = max(1, half_open_max)
+        self._clock = clock
+        self._on_state_change = on_state_change
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._half_open_inflight = 0
+        self._half_open_since = 0.0
+        self._stats = {"opened": 0, "rejected": 0, "failures": 0, "successes": 0}
+
+    # ------------------------------------------------------------- internals
+
+    def _transition(self, new_state: str) -> None:
+        old, self._state = self._state, new_state
+        if new_state is self.OPEN:
+            self._opened_at = self._clock()
+            self._stats["opened"] += 1
+        if new_state is self.HALF_OPEN:
+            self._half_open_inflight = 0
+            self._half_open_since = self._clock()
+        if old != new_state and self._on_state_change is not None:
+            cb = self._on_state_change
+            # Outside the lock path would be nicer, but callbacks here are
+            # metric writes (non-blocking, never re-entrant into allow()).
+            cb(old, new_state)
+
+    # ------------------------------------------------------------------ api
+
+    def set_state_change_callback(
+        self, cb: Optional[Callable[[str, str], None]]
+    ) -> None:
+        """(Re)wire the transition observer — lets the owner of the
+        dependency (who knows how to log/export it) attach after the
+        breaker was constructed elsewhere."""
+        with self._lock:
+            self._on_state_change = cb
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (
+            self._state is self.OPEN
+            and self._clock() - self._opened_at >= self.recovery_s
+        ):
+            self._transition(self.HALF_OPEN)
+        elif (
+            self._state is self.HALF_OPEN
+            and self._half_open_inflight >= self.half_open_max
+            and self._clock() - self._half_open_since >= self.recovery_s
+        ):
+            # A probe slot leaked (its caller died between allow() and
+            # record_*): re-arm after another recovery window instead of
+            # wedging half-open with no capacity forever.
+            self._half_open_since = self._clock()
+            self._half_open_inflight = 0
+
+    def allow(self) -> bool:
+        """True when a call may proceed (counts a half-open probe slot)."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state is self.CLOSED:
+                return True
+            if self._state is self.HALF_OPEN:
+                if self._half_open_inflight < self.half_open_max:
+                    self._half_open_inflight += 1
+                    return True
+            self._stats["rejected"] += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._stats["successes"] += 1
+            self._consecutive_failures = 0
+            if self._state is not self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._stats["failures"] += 1
+            self._consecutive_failures += 1
+            if self._state is self.HALF_OPEN:
+                self._transition(self.OPEN)
+            elif (
+                self._state is self.CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._transition(self.OPEN)
+
+    def state_code(self) -> float:
+        """Numeric encoding for a metrics gauge (0/1/2)."""
+        return self._STATE_CODES[self.state]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            self._maybe_half_open()
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                **self._stats,
+            }

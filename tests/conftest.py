@@ -16,10 +16,23 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# jax < 0.5 has no `jax_num_cpu_devices` config option; the XLA flag is the
+# portable spelling and must be in the environment before the CPU backend is
+# first touched (conftest imports before any test module, so this is early
+# enough even when sitecustomize already imported jax).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS spelling above already applies
 
 import pytest  # noqa: E402
 
@@ -29,3 +42,5 @@ def eight_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
     return devices[:8]
+
+

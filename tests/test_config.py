@@ -74,6 +74,27 @@ def test_example_config_parses_to_reference_topology():
     assert cfg.sampling.repetition_penalty == 1.2
     assert cfg.gate.threshold == 0.6                      # reference gate
     assert cfg.cluster.linearizable_reads is True
+    assert cfg.resilience.queue_depth == 64               # bounded admission
+    assert cfg.resilience.breaker_failure_threshold == 5
+
+
+def test_resilience_section_and_client_kwargs(tmp_path):
+    f = tmp_path / "r.toml"
+    f.write_text(
+        "[resilience]\n"
+        "llm_timeout_s = 15.0\n"
+        "queue_depth = 4\n"
+        "breaker_recovery_s = 1.5\n"
+        "backoff_max_s = 0.5\n"
+    )
+    cfg = cfg_lib.load_config(str(f))
+    assert cfg.resilience.llm_timeout_s == 15.0
+    assert cfg.resilience.queue_depth == 4
+    assert cfg.resilience.breaker_recovery_s == 1.5
+    kw = cfg_lib.client_kwargs(cfg)
+    assert kw["llm_timeout_s"] == 15.0 and kw["backoff_max_s"] == 0.5
+    # Unset knobs keep their defaults.
+    assert cfg.resilience.deadline_floor_s == 0.25
 
 
 def test_unknown_keys_rejected(tmp_path):
@@ -83,6 +104,9 @@ def test_unknown_keys_rejected(tmp_path):
         cfg_lib.load_config(str(bad))
     bad.write_text("[tutorng]\nmodel = 'gpt2'\n")
     with pytest.raises(ValueError, match="tutorng"):
+        cfg_lib.load_config(str(bad))
+    bad.write_text("[resilience]\nqueue_dpeth = 4\n")
+    with pytest.raises(ValueError, match="queue_dpeth"):
         cfg_lib.load_config(str(bad))
 
 

@@ -1,0 +1,19 @@
+"""Tier-1 guard: soak-shaped tests must be marked `slow`.
+
+Runs the same audit as `python scripts/audit_markers.py` (tier-1 executes
+with `-m 'not slow'` under a hard timeout, so one unmarked soak blows the
+whole budget — this makes the convention self-enforcing).
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "scripts"))
+
+from audit_markers import audit  # noqa: E402
+
+
+def test_slow_marker_convention_enforced():
+    violations = audit(REPO / "tests")
+    assert not violations, "\n".join(violations)

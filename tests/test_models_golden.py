@@ -25,6 +25,11 @@ from distributed_lms_raft_llm_tpu.models import bert, convert, gpt2
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
+try:
+    enable_x64 = jax.enable_x64  # jax >= 0.5
+except AttributeError:  # pragma: no cover - version-dependent
+    from jax.experimental import enable_x64
+
 
 @pytest.fixture(scope="module")
 def hf_gpt2():
@@ -60,7 +65,7 @@ def hf_bert():
 def test_gpt2_logits_match_hf(hf_gpt2):
     hf_cfg, hf_model = hf_gpt2
     hf_model = hf_model.double()
-    with jax.enable_x64(True):
+    with enable_x64(True):
         cfg = dataclasses.replace(
             convert.gpt2_config_from_hf(hf_cfg.to_dict()),
             dtype=jnp.float64,
@@ -84,7 +89,7 @@ def test_gpt2_kv_cache_decode_matches_full_forward(hf_gpt2):
     graph shapes differ by accumulation order alone (~1e-3 worst case).
     """
     hf_cfg, hf_model = hf_gpt2
-    with jax.enable_x64(True):
+    with enable_x64(True):
         cfg = dataclasses.replace(
             convert.gpt2_config_from_hf(hf_cfg.to_dict()),
             dtype=jnp.float64,
@@ -117,7 +122,7 @@ def test_gpt2_left_padded_prefill(hf_gpt2):
     cfg = convert.gpt2_config_from_hf(hf_cfg.to_dict())
 
     rng = np.random.default_rng(2)
-    with jax.enable_x64(True):
+    with enable_x64(True):
         cfg = dataclasses.replace(cfg, dtype=jnp.float64, param_dtype=jnp.float64)
         params = convert.gpt2_params_from_hf(hf_model.state_dict(), cfg)
         ids = jnp.asarray(rng.integers(1, cfg.vocab_size, size=(1, 6)))
@@ -142,7 +147,7 @@ def test_gpt2_left_padded_prefill(hf_gpt2):
 def test_bert_hidden_states_match_hf(hf_bert):
     hf_cfg, hf_model = hf_bert
     hf_model = hf_model.double()
-    with jax.enable_x64(True):
+    with enable_x64(True):
         cfg = dataclasses.replace(
             convert.bert_config_from_hf(hf_cfg.to_dict()),
             dtype=jnp.float64,

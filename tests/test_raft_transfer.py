@@ -178,6 +178,25 @@ class TestCore:
         core.on_vote_response(3, VoteResponse(term=3, granted=True), 10.02)
         assert core.role is Role.LEADER
 
+    def test_equal_term_append_from_other_leader_demotes_campaign(self):
+        # Mid-campaign, an equal-term append from a leader OTHER than the
+        # abdicating one means that term is already won elsewhere: step
+        # down and accept immediately instead of stalling convergence by
+        # up to an election timeout (ADVICE round 5).
+        from distributed_lms_raft_llm_tpu.raft.messages import AppendRequest
+
+        core = RaftCore(2, [1, 2, 3], MemoryStorage(), RaftConfig(),
+                        now=0.0, seed=12)
+        core.current_term = 2
+        core.on_timeout_now(TimeoutNowRequest(term=2, leader_id=1), 10.0)
+        assert core.role is Role.CANDIDATE
+        hb = AppendRequest(term=2, leader_id=3, prev_log_index=0,
+                           prev_log_term=0, entries=(), leader_commit=0)
+        resp = core.on_append_request(hb, 10.01)
+        assert resp.success
+        assert core.role is Role.FOLLOWER
+        assert core.leader_id == 3
+
     def test_leader_goes_quiet_to_target_after_timeout_now(self):
         core = _leader_core()
         core.transfer_leadership(1.0, target=2)

@@ -325,6 +325,50 @@ class TestEngineWiring:
         # Prefill token excluded: the ceiling is exactly spec_tokens + 1.
         assert tpw is not None and 0.0 < tpw <= 5.0
 
+    def test_warmup_caps_bucket_inside_position_budget(self):
+        # tiny has max_position_embeddings=64: an uncapped warmup at
+        # length_buckets[0]=48 with max_new=16 + k=4 would oversubscribe
+        # the position table (48+16+4-1=67 > 64) and trip decode_spec's
+        # new budget validation on a shape real traffic can never reach
+        # (encode_prompts caps at _max_prompt_len). warmup must cap too.
+        from distributed_lms_raft_llm_tpu.engine import (
+            EngineConfig,
+            TutoringEngine,
+        )
+
+        eng = TutoringEngine(EngineConfig(
+            model="tiny",
+            sampling=SamplingParams.greedy(max_new_tokens=16),
+            length_buckets=(48,), batch_buckets=(1,), spec_tokens=4,
+        ))
+        eng.warmup(batch=1)  # must not raise
+        answers = eng.answer_batch(["a question after warmup"])
+        assert len(answers) == 1
+
+    def test_decode_spec_rejects_oversubscribed_position_budget(self):
+        # Direct decode_spec callers get a loud error, not silently
+        # clamped (wrong) position embeddings (ADVICE round 5): prefill's
+        # own guard passes (t + max_new == mpe) but the spec window's
+        # k-1 overhang does not fit.
+        from distributed_lms_raft_llm_tpu.engine import generate as gen_lib
+        from distributed_lms_raft_llm_tpu.engine.spec import decode_spec
+        from distributed_lms_raft_llm_tpu.models import registry
+
+        family, cfg = registry.resolve("tiny", jnp.float32)
+        params = family.init_params(jax.random.PRNGKey(0), cfg)
+        t = 8
+        sampling = SamplingParams.greedy(
+            max_new_tokens=cfg.max_position_embeddings - t
+        )
+        ids = jnp.ones((1, t), jnp.int32)
+        mask = jnp.ones((1, t), bool)
+        state = gen_lib.prefill(params, cfg, ids, mask,
+                                jax.random.PRNGKey(1), sampling,
+                                eos_id=0, pad_id=0, model=family)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            decode_spec(params, state, ids, cfg, sampling, eos_id=0,
+                        pad_id=0, model=family, spec_tokens=4)
+
     def test_engine_rejects_spec_with_fused_attention(self):
         from distributed_lms_raft_llm_tpu.engine import (
             EngineConfig,

@@ -1,6 +1,7 @@
 """Sharded training step on the 8-device mesh + graft entry points."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -126,9 +127,17 @@ def _tiny_dataset():
     return PackedDataset(blocks, DataConfig(batch_size=8, seq_len=16, seed=1))
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip_and_resume_bitexact(tmp_path):
     """Interrupted-and-resumed training walks the same step sequence as an
-    uninterrupted run: final params match bit-for-bit."""
+    uninterrupted run: final params match bit-for-bit.
+
+    Marked slow: the three back-to-back fit() compilations are the
+    heaviest single test in the suite, and in this image's jax build the
+    test aborts the interpreter (SIGABRT inside XLA, non-deterministic
+    crash point) when it runs at the tail of the full in-process tier-1
+    session — while passing standalone and in the slow lane every time.
+    """
     from distributed_lms_raft_llm_tpu.train import train as train_lib
 
     mesh = make_mesh({"tp": 1, "dp": -1})
