@@ -20,6 +20,7 @@ import os
 import subprocess
 import sys
 import time
+from functools import partial
 
 import numpy as np
 
@@ -132,6 +133,90 @@ def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False,
     }
 
 
+def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
+                batch: int = BATCH, spec_tokens: int = 0,
+                greedy: bool = False, chunk: int = 16) -> dict:
+    """Continuous-batching throughput/TTFT through PagedEngine directly.
+
+    Same shape of numbers as bench_tpu so paged and paged+spec enter the
+    recorded perf trajectory: sustained tokens/sec/chip with `batch` busy
+    slots (ROUNDS x batch requests churning through), then idle-engine
+    batch-1 TTFT medians. Spec acceptance rides along when spec_tokens>0.
+    """
+    import jax
+
+    from distributed_lms_raft_llm_tpu.engine import (
+        EngineConfig,
+        PagedEngine,
+        SamplingParams,
+    )
+
+    n_chips = max(1, len(jax.devices()))
+    artifacts = ensure_local_artifacts() if model == "gpt2" else {}
+    sampling = (
+        SamplingParams.greedy(max_new_tokens=MAX_NEW) if greedy
+        else SamplingParams.reference_defaults(max_new_tokens=MAX_NEW)
+    )
+    engine = PagedEngine(
+        EngineConfig(
+            model=model,
+            sampling=sampling,
+            length_buckets=(PROMPT_LEN, 64, 128),
+            batch_buckets=tuple(sorted({1, 2, 4, 8, batch})),
+            tp=tp,
+            quant="int8" if quant else None,
+            kv_quant=quant,
+            spec_tokens=spec_tokens,
+            **artifacts,
+        ),
+        slots=batch,
+        chunk=chunk,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        engine.tokenizer.decode(
+            rng.integers(0, engine.tokenizer.vocab_size, PROMPT_LEN).tolist()
+        )
+        for _ in range(ROUNDS * batch)
+    ]
+    compile_s = engine.warmup()
+
+    engine.pop_spec_stats()
+    engine.total_generated_tokens = 0
+    t0 = time.monotonic()
+    for p in prompts:
+        engine.submit(p)
+    engine.drain()
+    elapsed = time.monotonic() - t0
+    tps = engine.total_generated_tokens / elapsed
+    spec_stats = engine.pop_spec_stats()
+    engine.pop_ttfts()
+
+    # Idle-engine TTFT (same protocol as bench_tpu: median of 7 batch-1
+    # runs, measured submit -> first token on host).
+    lat = []
+    for _ in range(7):
+        rid = engine.submit(prompts[0])
+        engine.drain()
+        lat.append(engine.pop_ttfts()[rid])
+    ttft_ms = sorted(lat)[len(lat) // 2] * 1000.0
+
+    out = {
+        "tokens_per_sec_per_chip": tps / n_chips,
+        "requests_per_s": len(prompts) / elapsed,
+        "ttft_p50_ms": ttft_ms,
+        "compile_s": compile_s,
+        "batch": batch,
+        "platform": jax.devices()[0].platform,
+    }
+    if spec_stats is not None:
+        windows, emitted = spec_stats
+        out["spec_tokens_per_window"] = (
+            emitted / windows if windows else None
+        )
+    return out
+
+
 def bench_torch_baseline(model: str = "gpt2", budget_new_tokens: int = 32) -> float:
     """Reference path: torch-CPU GPT-2 (matching size), sequential queries."""
     arch = {
@@ -187,12 +272,20 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=BATCH,
                     help="device batch (BASELINE config is 8)")
     ap.add_argument("--spec-tokens", type=int, default=0,
-                    help="speculative decoding draft window (engine/spec.py; "
-                         "exact). Measured win is on the greedy low-batch "
-                         "path — pair with --greedy --batch 1")
+                    help="speculative decoding draft window (engine/draft.py "
+                         "kernels; exact). Measured win is on the greedy "
+                         "low-batch path — pair with --greedy --batch 1, or "
+                         "with --paged for the unified serving config")
     ap.add_argument("--greedy", action="store_true",
                     help="temperature-0 sampling instead of the reference "
                          "params (the speculative serving configuration)")
+    ap.add_argument("--paged", action="store_true",
+                    help="bench the continuous-batching PagedEngine instead "
+                         "of the group-batched engine (composes with "
+                         "--spec-tokens: per-slot verify windows)")
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="paged: tokens (spec: verify windows) per "
+                         "dispatched step program")
     ap.add_argument("--config", default=None,
                     help="TOML deployment file; [tutoring] model/tp apply")
     args = ap.parse_args()
@@ -206,14 +299,18 @@ def main() -> None:
         if args.tp == 1:
             args.tp = t.tp
     extra = dict(spec_tokens=args.spec_tokens, greedy=args.greedy)
-    quant = (bench_tpu(args.model, args.tp, quant=True, batch=args.batch,
-                       **extra)
+    run = bench_tpu
+    if args.paged:
+        run = partial(bench_paged, chunk=args.chunk)
+    quant = (run(args.model, args.tp, quant=True, batch=args.batch, **extra)
              if args.tp == 1 else None)
-    tpu = bench_tpu(args.model, args.tp, batch=args.batch, **extra)
+    tpu = run(args.model, args.tp, batch=args.batch, **extra)
     baseline_tps = bench_torch_baseline(args.model)
     name = {"gpt2": "gpt2_small"}.get(args.model, args.model.replace("-", "_"))
     if args.tp > 1:
         name += f"_tp{args.tp}"
+    if args.paged:
+        name += "_paged"
     if args.greedy:
         name += "_greedy"
     if args.spec_tokens:
@@ -232,6 +329,12 @@ def main() -> None:
         "compile_s": round(head["compile_s"], 1),
         "platform": head["platform"],
     }
+    if "requests_per_s" in head:
+        record["requests_per_s"] = round(head["requests_per_s"], 2)
+    if head.get("spec_tokens_per_window") is not None:
+        record["spec_tokens_per_window"] = round(
+            head["spec_tokens_per_window"], 2
+        )
     if quant:
         # Full-precision numbers ride along for cross-round continuity.
         record["bf16_tokens_per_sec"] = round(

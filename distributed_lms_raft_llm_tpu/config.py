@@ -73,12 +73,14 @@ class TutoringConfig:
     ep: int = 1                  # expert-parallel ways (MoE presets)
     quant: Optional[str] = None  # "int8" = weight-only int8
     kv_quant: bool = False
-    spec_tokens: int = 0         # speculative decoding draft window (exact)
+    spec_tokens: int = 0         # speculative decoding draft window (exact;
+    #                              both engines — composes with paged)
     paged: bool = False          # continuous batching
     max_batch: int = 8
     max_wait_ms: float = 10.0
     slots: Optional[int] = None
-    chunk: int = 16              # paged: tokens per dispatched step program
+    chunk: int = 16              # paged: tokens (spec: verify windows) per
+    #                              dispatched step program
     auth_key_file: Optional[str] = None
 
     @property

@@ -340,6 +340,22 @@ class PagedQueue:
                 if self.metrics is not None:
                     for ttft in ttfts.values():
                         self.metrics.hist("ttft").observe(ttft)
+                    spec = getattr(self.engine, "pop_spec_stats",
+                                   lambda: None)()
+                    if spec is not None:
+                        windows, emitted = spec
+                        if windows:
+                            # Speculation effectiveness on the default
+                            # serving path: mean emitted tokens per verify
+                            # window (gauge; 1.0 = nothing accepted) and
+                            # the cumulative tokens speculation produced
+                            # beyond the guaranteed one per window.
+                            self.metrics.set_gauge(
+                                "spec_tokens_per_window", emitted / windows
+                            )
+                            self.metrics.inc(
+                                "spec_accepted_tokens", emitted - windows
+                            )
                 for rid, text in done:
                     self._pending_deadlines.pop(rid, None)
                     f = self._futures.pop(rid, None)

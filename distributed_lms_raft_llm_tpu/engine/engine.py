@@ -82,12 +82,16 @@ class EngineConfig:
     # in BENCH_NOTES.md). None = auto from the batch size (4 small / 8
     # large); 1 = single full-size while_loop.
     decode_segments: Optional[int] = None
-    # Speculative decoding (engine/spec.py): propose this many prompt-lookup
-    # draft tokens per step and verify them in one forward with exact
-    # rejection sampling — several tokens per model call, identical output
-    # distribution. 0 = off. Wins where per-step fixed costs dominate (the
-    # batch-1..4 single-student latency path); supersedes decode_segments
-    # when set (the spec cache grows once to its high-water width).
+    # Speculative decoding (engine/draft.py kernels): propose this many
+    # prompt-lookup draft tokens per step and verify them in one forward
+    # with exact rejection sampling — several tokens per model call,
+    # identical output distribution. 0 = off. Honored by BOTH engines:
+    # TutoringEngine swaps decode for engine/spec.decode_spec (supersedes
+    # decode_segments; the spec cache grows once to its high-water width),
+    # and PagedEngine generalizes its chunked step to per-slot verify
+    # windows (engine/paged._spec_step_program — slot lengths advance
+    # raggedly by per-row accepted counts). Wins where per-step fixed
+    # costs dominate: low batch, or a paged batch running below capacity.
     spec_tokens: int = 0
     dtype: Any = jnp.bfloat16
     # Serving stores weights in bf16: halves the HBM read per decode step
@@ -231,6 +235,9 @@ class TutoringEngine:
         # never blocks on a readback, yet the gauge still updates.
         self._pending_spec_stats = None
         self._last_spec_tpw: Optional[float] = None
+        # Tokens produced through answer_batch (bench harnesses divide by
+        # wall clock for tokens/sec through the serving path).
+        self.total_generated_tokens = 0
         self._score_fn = None  # built lazily on first score() call
 
     @property
@@ -492,6 +499,7 @@ class TutoringEngine:
             ttfts.extend([queued_s + (self.last_ttft_s or 0.0)] * len(chunk))
             for i in range(len(chunk)):
                 n = int(result.lengths[i])
+                self.total_generated_tokens += n
                 toks = [t for t in result.tokens[i, :n].tolist()
                         if t != self.tokenizer.eos_id]
                 answers.append(self.tokenizer.decode(toks))

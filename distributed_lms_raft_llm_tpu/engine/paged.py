@@ -23,7 +23,13 @@ Three jitted programs, compiled once each:
 - `_install`: splices a prefilled slot into the live donated state;
 - `_step`: [S,1] last-tokens forward with per-row cache offsets (the
   models' ragged-slot scatter path), fused sampling, lengths/active
-  update, scanned over `chunk` tokens.
+  update, scanned over `chunk` tokens. With `EngineConfig.spec_tokens=k`
+  set, the step generalizes to a [S, k+1] verify window per scan
+  iteration (`_spec_step_program`): prompt-lookup drafts from the
+  device-side transcript, one forward over the window, exact rejection
+  sampling (`engine.draft`, shared with `engine.spec`) — rows accept
+  different counts, so slot lengths advance raggedly between host
+  dispatches and the host reaps a per-window token count.
 
 The reference has no analogue (HF `generate`, one request at a time —
 reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
@@ -48,6 +54,7 @@ from ..parallel import mesh as mesh_lib
 from ..parallel import partition
 from ..utils import tokenizer as tok_lib
 from ..utils.compilation import enable_compilation_cache
+from .draft import build_drafts, verify_window
 from .engine import EngineConfig
 from .generate import pick_bucket
 from .sampling import (
@@ -67,6 +74,12 @@ class SlotState(NamedTuple):
     tok: jax.Array     # [S] last sampled token per slot
     active: jax.Array  # [S] bool
     seen: jax.Array    # [S, V] repetition-penalty presence mask
+    # [S, W] per-slot token transcript mirroring the cache layout
+    # (right-padded: transcript slot j = the token whose KV lives — or
+    # will live — in cache slot j). Slots <= cache.length hold real
+    # tokens. Feeds the prompt-lookup drafter in spec mode; carried
+    # unchanged (aliased in place by donation) by the plain step.
+    transcript: jax.Array
 
 
 @dataclasses.dataclass
@@ -80,6 +93,29 @@ class _Request:
     # was known still carry this request in their slot snapshot and must
     # skip it (see PagedEngine.step pipelining).
     finished: bool = False
+
+
+def _state_spec(x: jax.Array) -> jax.sharding.PartitionSpec:
+    """The canonical replicated-spec SPELLING for a SlotState plane.
+
+    Different producers of the same SlotState leaf (install's scatter,
+    grow's pad, the step scan, reap's eager active-kill) let GSPMD pick
+    spelling-different specs for the same replicated layout — `P()` vs
+    `P(None, None)` — and the pjit cache keys on the spelling, so the
+    step program silently compiled once per PRODUCER per width (warmup's
+    compile did not cover the live install->step handoff, leaving a
+    hidden first-request XLA compile per width in production). The
+    engine therefore respells the host-state planes to one canonical
+    spec at every step-dispatch boundary (`_canon_state` — a zero-copy
+    Array rewrap), making each (S, k, width) step program compile
+    exactly once: guarded by tests/test_paged_spec.py. The KV cache k/v
+    planes are never touched: their sharding belongs to the partitioner
+    (tp meshes shard the heads axis), and a device_put against a
+    non-equivalent sharding would be a real reshard, not a rewrap.
+    """
+    if x.ndim < 2:
+        return jax.sharding.PartitionSpec()
+    return jax.sharding.PartitionSpec(*([None] * x.ndim))
 
 
 def _prefill_program(params, ids, true_len, rng, *, cfg, sampling, model):
@@ -114,9 +150,18 @@ def cfg_tmax(cfg, sampling: SamplingParams, bucket: int) -> int:
     return min(bucket + sampling.max_new_tokens, cfg.max_position_embeddings)
 
 
-def _install_program(state: SlotState, slot, c1: KVCache, true_len, first,
-                     seen_row, *, eos_id: int) -> SlotState:
-    """Splice a prefilled slot into the live state (one fused program)."""
+def _install_program(state: SlotState, slot, c1: KVCache, ids, true_len,
+                     first, seen_row, *, eos_id: int) -> SlotState:
+    """Splice a prefilled slot into the live state (one fused program).
+
+    `ids` is the [1, t] right-padded prompt (the same array `_prefill`
+    consumed): it seeds the slot's transcript row — prompt tokens in
+    transcript slots 0..true_len-1, the first sampled token at slot
+    true_len (its cache slot). Stale tokens from the slot's previous
+    occupant beyond the prompt bucket are harmless: the drafter only
+    reads transcript slots <= cache.length, all (re)written by the
+    current occupant before its length reaches them.
+    """
     zero = jnp.zeros((), jnp.int32)
     ck = jax.lax.dynamic_update_slice(
         state.cache.k, c1.k, (zero, slot, zero, zero, zero)
@@ -133,11 +178,16 @@ def _install_program(state: SlotState, slot, c1: KVCache, true_len, first,
             state.cache.vs, c1.vs, (zero, slot, zero, zero)
         )
     lengths = state.cache.length.at[slot].set(true_len)
+    transcript = jax.lax.dynamic_update_slice(
+        state.transcript, ids, (slot, zero)
+    )
+    transcript = transcript.at[slot, true_len].set(first)
     return SlotState(
         cache=KVCache(ck, cv, lengths, ks=cks, vs=cvs),
         tok=state.tok.at[slot].set(first),
         active=state.active.at[slot].set(first != eos_id),
         seen=state.seen.at[slot].set(seen_row),
+        transcript=transcript,
     )
 
 
@@ -145,8 +195,8 @@ def _grow_state_program(state: SlotState, new_len: int) -> SlotState:
     """Zero-pad the cache's slot axis up to `new_len` (width-bucket growth:
     the live cache is only as wide as the widest ACTIVE request needs —
     see PagedEngine._admit — and pads up when a longer prompt arrives)."""
-    pad = [(0, 0), (0, 0), (0, 0),
-           (0, new_len - state.cache.k.shape[3]), (0, 0)]
+    grow = new_len - state.cache.k.shape[3]
+    pad = [(0, 0), (0, 0), (0, 0), (0, grow), (0, 0)]
     cache = state.cache._replace(
         k=jnp.pad(state.cache.k, pad),
         v=jnp.pad(state.cache.v, pad),
@@ -155,7 +205,10 @@ def _grow_state_program(state: SlotState, new_len: int) -> SlotState:
         vs=None if state.cache.vs is None else jnp.pad(state.cache.vs,
                                                        pad[:-1]),
     )
-    return state._replace(cache=cache)
+    return state._replace(
+        cache=cache,
+        transcript=jnp.pad(state.transcript, [(0, 0), (0, grow)]),
+    )
 
 
 def _step_program(params, state: SlotState, rng, *, cfg, sampling,
@@ -204,12 +257,109 @@ def _step_program(params, state: SlotState, rng, *, cfg, sampling,
                 tok=nxt,
                 active=still,
                 seen=seen,
+                transcript=s.transcript,
             ),
             nxt,
         )
 
     state, toks = jax.lax.scan(one, state, jax.random.split(rng, chunk))
     return state, toks, state.active.astype(jnp.int8)
+
+
+def _spec_step_program(
+    params, state: SlotState, rng, *, cfg, sampling, eos_id: int,
+    pad_id: int, model, spec_tokens: int, chunk: int = 1,
+) -> Tuple[SlotState, jax.Array, jax.Array, jax.Array]:
+    """`chunk` speculative verify windows for all S slots.
+
+    Each scan iteration generalizes the [S, 1] step to a [S, k+1] window:
+    prompt-lookup drafts come from the device-side transcript (the paged
+    layout is right-padded, so transcript slot == cache slot == position
+    id), one forward writes the window's KV at per-row ragged offsets
+    (models' scatter path, T = k+1), and `draft.verify_window` walks the
+    drafts with exact rejection sampling. Rows accept different counts, so
+    per-slot lengths advance raggedly WITHIN a dispatch; the host learns
+    each window's emission count from the returned `counts` plane.
+
+    Window invariant (same proof as engine/spec.py): a row's next window
+    starts `m >= 1` slots after the previous one and spans k+1 slots, so
+    it rewrites every garbage slot a rejected draft left behind before
+    anything can attend to it; the causal mask hides the window's own
+    not-yet-written tail. Rows that ran past the host's budget clamp
+    their window base to `width - 1 - k` (the host force-finishes them at
+    max_new; the clamped rewrites are garbage nothing reads) — the same
+    role as the plain step's `tmax - 1` clamp, widened for the window.
+
+    Returns (state, emitted [chunk, S, k+1], counts [chunk, S] int32,
+    active_snapshot [S] int8). Per (iteration, slot), the first
+    `counts[c, s]` columns of `emitted[c, s]` are that window's tokens in
+    order (`verify_window`'s valid plane is a contiguous prefix); count 0
+    means the slot was inactive. Like the plain step's outputs, all three
+    are fresh buffers that survive the next dispatch donating the state.
+    """
+    k = spec_tokens
+    width = state.cache.k.shape[3]
+    pos_w = jnp.arange(width, dtype=jnp.int32)[None, :]
+    offs_k1 = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+
+    def one(s: SlotState, step_rng):
+        offs = jnp.minimum(s.cache.length, width - 1 - k)  # [S] window base
+        # Drafts: the pending last token sits at transcript slot `offs`;
+        # an anchor must be filled AND have k filled continuation slots
+        # (a frontier-adjacent anchor would propose unwritten slots).
+        prev = jnp.take_along_axis(
+            s.transcript, jnp.maximum(offs - 1, 0)[:, None], axis=1
+        )[:, 0]
+        match_valid = pos_w <= (offs - k)[:, None]
+        drafts = build_drafts(s.transcript, match_valid, prev, s.tok, k)
+
+        # One forward over [last, d_1..d_k]: KV scatters at slots
+        # offs..offs+k, queries attend causally (key slot <= query slot) —
+        # history below `offs` is real, the window prefix was just
+        # written, everything above is masked. Right-padding means no
+        # kv_mask is needed (no interior pad holes) and positions default
+        # to the slot indices.
+        feed = jnp.concatenate([s.tok[:, None], drafts], axis=1)  # [S, k+1]
+        logits, cache = model.forward(
+            params, cfg, feed, cache=s.cache._replace(length=offs)
+        )
+        emitted, valid, seen, hit_eos = verify_window(
+            step_rng, logits, drafts, s.seen, s.active, sampling,
+            eos_id, pad_id,
+        )
+        # Emitted token i lands at transcript slot offs+1+i (the slot its
+        # KV will occupy once it is fed). Clamp-overrun rows route their
+        # writes out of bounds and drop them.
+        slots = (offs + 1)[:, None] + offs_k1  # [S, k+1]
+        valid = valid & (slots < width)
+        m = jnp.sum(valid, axis=1).astype(jnp.int32)  # [S] window emissions
+        rows = jnp.arange(s.tok.shape[0], dtype=jnp.int32)[:, None]
+        transcript = s.transcript.at[
+            rows, jnp.where(valid, slots, width)
+        ].set(emitted, mode="drop")
+        new_tok = jnp.where(
+            m > 0,
+            jnp.take_along_axis(
+                emitted, jnp.maximum(m - 1, 0)[:, None], axis=1
+            )[:, 0],
+            s.tok,
+        )
+        lengths = jnp.where(s.active, offs + m, s.cache.length)
+        return (
+            SlotState(
+                cache=cache._replace(length=lengths),
+                tok=new_tok,
+                active=s.active & ~hit_eos,
+                seen=seen,
+                transcript=transcript,
+            ),
+            (emitted, m),
+        )
+
+    state, (emitted, counts) = jax.lax.scan(
+        one, state, jax.random.split(rng, chunk)
+    )
+    return state, emitted, counts, state.active.astype(jnp.int8)
 
 
 class PagedEngine:
@@ -250,12 +400,21 @@ class PagedEngine:
                 "fused_attention is not supported by the paged engine "
                 "(per-slot ragged cache offsets); use TutoringEngine"
             )
-        if config.spec_tokens:
-            # The chunked step program decodes one token per slot per step;
-            # a speculative verify window doesn't fit its admission model.
+        # Speculative decoding: k prompt-lookup drafts verified per slot
+        # per scan iteration (see _spec_step_program). 0 = the plain
+        # one-token chunked step.
+        self.spec = max(0, config.spec_tokens)
+        if (
+            self.spec
+            and self.family.name == "gpt2_moe"
+            and self.cfg.capacity_factor < self.cfg.num_experts
+        ):
+            # Mirror TutoringEngine: capacity drops make a token's output
+            # depend on its forward-pass companions, so the verify window
+            # would sample from different distributions than step decode.
             raise ValueError(
-                "spec_tokens is not supported by the paged engine; use "
-                "TutoringEngine for speculative decoding"
+                "spec_tokens with an MoE model requires capacity_factor >= "
+                "num_experts (no token dropping; models/moe.py caveat)"
             )
         if config.ep > 1 and self.family.name != "gpt2_moe":
             # Mirror TutoringEngine: silently replicating the ep ways into
@@ -281,25 +440,33 @@ class PagedEngine:
         # prompts keep their tail via submit()'s truncation). Without this,
         # a request reaching tmax mid-decode would have its newest KV slot
         # silently overwritten by the clamped scatter in `_step_program`.
+        # Spec mode keeps its verify windows inside the table too: the
+        # widest window the host still consumes from ends k-1 slots past
+        # the last budgeted token.
+        self._spec_extra = max(0, self.spec - 1)
         self.bucket = min(
             max(config.length_buckets),
-            self.cfg.max_position_embeddings - config.sampling.max_new_tokens,
+            self.cfg.max_position_embeddings
+            - config.sampling.max_new_tokens - self._spec_extra,
         )
         if self.bucket < 1:
             raise ValueError(
-                f"max_new {config.sampling.max_new_tokens} leaves no room "
-                f"for any prompt token in the position table "
-                f"{self.cfg.max_position_embeddings}"
+                f"max_new {config.sampling.max_new_tokens} "
+                + (f"+ spec overhang {self._spec_extra} " if self.spec else "")
+                + f"leaves no room for any prompt token in the position "
+                f"table {self.cfg.max_position_embeddings}"
             )
         self.tmax = cfg_tmax(self.cfg, config.sampling, self.bucket)
         # Cache-width buckets: one admissible width per prompt bucket
-        # (bucket + max_new). The live cache runs at the width the widest
-        # ACTIVE request needs instead of always tmax — every decode step's
-        # attention streams the whole slot axis, so a cluster of short
-        # prompts pays ~half the KV bytes of the worst case (the bucketed
-        # engine's segmented decode, ported to the slot world).
+        # (bucket + max_new, plus the verify window's k-1 overhang in spec
+        # mode). The live cache runs at the width the widest ACTIVE request
+        # needs instead of always tmax — every decode step's attention
+        # streams the whole slot axis, so a cluster of short prompts pays
+        # ~half the KV bytes of the worst case (the bucketed engine's
+        # segmented decode, ported to the slot world).
         self.widths = sorted({
             cfg_tmax(self.cfg, config.sampling, min(b, self.bucket))
+            + self._spec_extra
             for b in config.length_buckets
         })
 
@@ -325,12 +492,20 @@ class PagedEngine:
             partial(_install_program, eos_id=self.tokenizer.eos_id),
             donate_argnums=(0,),
         )
-        self._step = jax.jit(
-            partial(_step_program, eos_id=self.tokenizer.eos_id,
-                    pad_id=self.tokenizer.pad_id, chunk=self.chunk,
-                    **statics),
-            donate_argnums=(1,),
-        )
+        if self.spec:
+            self._step = jax.jit(
+                partial(_spec_step_program, eos_id=self.tokenizer.eos_id,
+                        pad_id=self.tokenizer.pad_id, chunk=self.chunk,
+                        spec_tokens=self.spec, **statics),
+                donate_argnums=(1,),
+            )
+        else:
+            self._step = jax.jit(
+                partial(_step_program, eos_id=self.tokenizer.eos_id,
+                        pad_id=self.tokenizer.pad_id, chunk=self.chunk,
+                        **statics),
+                donate_argnums=(1,),
+            )
         self._grow = jax.jit(
             _grow_state_program, static_argnums=(1,), donate_argnums=(0,)
         )
@@ -339,16 +514,28 @@ class PagedEngine:
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: List[_Request] = []
         # Dispatched-but-unread chunk programs, oldest first:
-        # (tokens [chunk, S] device array, active [S] int8 device array,
+        # (tokens device array — [chunk, S] plain / [chunk, S, k+1] spec,
+        #  counts [chunk, S] device array in spec mode else None,
+        #  active [S] int8 device array,
         #  slot->request snapshot at dispatch time).
         self._inflight: List[
-            Tuple[jax.Array, jax.Array, List[Optional[_Request]]]
+            Tuple[jax.Array, Optional[jax.Array], jax.Array,
+                  List[Optional[_Request]]]
         ] = []
         self._next_rid = 0
         self.last_ttft_s: Optional[float] = None
         # Per-request time-to-first-token (submit() -> first token on host),
         # keyed by rid; the serving queue pops these into its histogram.
         self.ttfts: Dict[int, float] = {}
+        # Speculation observability, accumulated at reap time from the
+        # device counts plane and drained by pop_spec_stats(): windows run
+        # for live slots and tokens they emitted (emitted/windows is the
+        # mean tokens-per-window; 1.0 = nothing accepted).
+        self._spec_windows = 0
+        self._spec_emitted = 0
+        # Tokens finished requests generated (bench harnesses divide by
+        # wall clock for tokens/sec through the serving path).
+        self.total_generated_tokens = 0
 
     def _init_state(self, width: Optional[int] = None) -> SlotState:
         cache = self.family.init_cache(
@@ -356,11 +543,36 @@ class PagedEngine:
             dtype=self.cfg.dtype,
         )
         cache = cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
-        return SlotState(
+        state = SlotState(
             cache=cache,
             tok=jnp.zeros((self.slots,), jnp.int32),
             active=jnp.zeros((self.slots,), bool),
             seen=jnp.zeros((self.slots, self.cfg.vocab_size), bool),
+            transcript=jnp.zeros(
+                (self.slots, cache.k.shape[3]), jnp.int32
+            ),
+        )
+        # Replicated mesh sharding from birth, in the canonical spelling:
+        # raw single-device arrays would key the jit caches differently
+        # than the programs' own (pinned) outputs, so the first
+        # install/step after a rebuild would silently recompile (see
+        # _state_spec). Cache k/v planes take the rank-agnostic `P()`
+        # spelling (what install/step donation-aliasing propagates);
+        # the host-state planes take their _state_spec spelling.
+        def put(x, spec=None):
+            return jax.device_put(x, jax.sharding.NamedSharding(
+                self.mesh, spec if spec is not None else _state_spec(x)
+            ))
+
+        rep = jax.sharding.PartitionSpec()
+        return state._replace(
+            cache=jax.tree_util.tree_map(
+                lambda x: put(x, rep), state.cache._replace(length=None)
+            )._replace(length=put(state.cache.length)),
+            tok=put(state.tok),
+            active=put(state.active),
+            seen=put(state.seen),
+            transcript=put(state.transcript),
         )
 
     # ------------------------------------------------------------ host API
@@ -408,11 +620,9 @@ class PagedEngine:
         )
         for width in self.widths:
             self.state = self._init_state(width)
-            self._rng, rng = jax.random.split(self._rng)
-            with self.mesh:
-                self.state, _, _ = self._step(self.params, self.state, rng)
             for t in buckets:
-                nat = cfg_tmax(self.cfg, self.config.sampling, t)
+                nat = (cfg_tmax(self.cfg, self.config.sampling, t)
+                       + self._spec_extra)
                 if nat > width:
                     continue  # a prompt this long can't run at this width
                 ids = np.full((1, t), self.tokenizer.pad_id, np.int32)
@@ -424,8 +634,16 @@ class PagedEngine:
                     )
                     self.state = self._install(
                         self.state, jnp.asarray(0, jnp.int32), c1,
-                        jnp.asarray(1, jnp.int32), first, seen_row,
+                        jnp.asarray(ids), jnp.asarray(1, jnp.int32),
+                        first, seen_row,
                     )
+            # Step AFTER an install so the compile covers the live
+            # install->step handoff (the state the step really sees);
+            # stepping a raw _init_state would key the cache differently.
+            self._rng, rng = jax.random.split(self._rng)
+            self.state = self._canon_state(self.state)
+            with self.mesh:
+                self.state = self._step(self.params, self.state, rng)[0]
         for i, wa in enumerate(self.widths):
             for wb in self.widths[i + 1:]:
                 throwaway = self._init_state(wa)
@@ -448,6 +666,21 @@ class PagedEngine:
     def pop_ttfts(self) -> Dict[int, float]:
         """Drain the per-request TTFT measurements recorded since last call."""
         out, self.ttfts = self.ttfts, {}
+        return out
+
+    def pop_spec_stats(self) -> Optional[Tuple[int, int]]:
+        """Drain (windows_run, tokens_emitted) accumulated at reap since the
+        last call; None when speculation is off. emitted/windows is the mean
+        tokens per verify window (1.0 = no draft accepted; the ceiling is
+        spec_tokens + 1); emitted - windows is the count of tokens the
+        windows produced beyond the guaranteed one each — the speculation
+        dividend. The serving queue turns these into the
+        `spec_tokens_per_window` gauge and `spec_accepted_tokens` counter.
+        """
+        if not self.spec:
+            return None
+        out = (self._spec_windows, self._spec_emitted)
+        self._spec_windows = self._spec_emitted = 0
         return out
 
     def reset(self) -> None:
@@ -513,7 +746,8 @@ class PagedEngine:
                 )
                 self.state = self._install(
                     self.state, jnp.asarray(slot, jnp.int32), c1,
-                    jnp.asarray(req.prompt_len, jnp.int32), first, seen_row,
+                    jnp.asarray(ids), jnp.asarray(req.prompt_len, jnp.int32),
+                    first, seen_row,
                 )
             admitted.append((slot, req, first))
         if not admitted:
@@ -531,10 +765,30 @@ class PagedEngine:
         bucket = min(
             pick_bucket(prompt_len, self.config.length_buckets), self.bucket
         )
-        return cfg_tmax(self.cfg, self.config.sampling, bucket)
+        return (cfg_tmax(self.cfg, self.config.sampling, bucket)
+                + self._spec_extra)
 
     def _live(self) -> bool:
         return any(r is not None and not r.finished for r in self._slot_req)
+
+    def _canon_state(self, state: SlotState) -> SlotState:
+        """Respell the host-state planes' replicated shardings to the one
+        canonical spec before a step dispatch (see _state_spec). A
+        device_put against an equivalent sharding is a zero-copy Array
+        rewrap (same buffer), so the steady state — planes already
+        canonical — costs five equality checks and nothing else."""
+
+        def put(x):
+            sh = jax.sharding.NamedSharding(self.mesh, _state_spec(x))
+            return x if x.sharding == sh else jax.device_put(x, sh)
+
+        return state._replace(
+            tok=put(state.tok),
+            active=put(state.active),
+            seen=put(state.seen),
+            transcript=put(state.transcript),
+            cache=state.cache._replace(length=put(state.cache.length)),
+        )
 
     def step(self) -> List[Tuple[int, str]]:
         """Admit pending requests, dispatch the next `chunk`-token program,
@@ -551,10 +805,17 @@ class PagedEngine:
         self._admit()
         if self._live():
             self._rng, rng = jax.random.split(self._rng)
+            self.state = self._canon_state(self.state)
             with self.mesh:
-                self.state, toks, active = self._step(
-                    self.params, self.state, rng
-                )
+                if self.spec:
+                    self.state, toks, counts, active = self._step(
+                        self.params, self.state, rng
+                    )
+                else:
+                    self.state, toks, active = self._step(
+                        self.params, self.state, rng
+                    )
+                    counts = None
             # No blocking readback here — but START the device->host copies
             # now, so the chunk's results stream back while later chunks
             # compute. On the high-latency bench link this is the entire
@@ -562,7 +823,9 @@ class PagedEngine:
             # chunk (measured), serializing the loop at ~270 tok/s; with
             # the copies in flight the same loop measures ~930 tok/s at
             # chunk=8 and ~1.9k at chunk=32.
-            for arr in (toks, active):
+            for arr in (toks, counts, active):
+                if arr is None:
+                    continue
                 try:
                     arr.copy_to_host_async()
                 except (AttributeError, NotImplementedError):
@@ -570,7 +833,8 @@ class PagedEngine:
             # The slot snapshot records which request each column belonged
             # to at dispatch time (a slot reused later belongs to a later
             # chunk).
-            self._inflight.append((toks, active, list(self._slot_req)))
+            self._inflight.append((toks, counts, active,
+                                   list(self._slot_req)))
         done: List[Tuple[int, str]] = []
         while self._inflight and (
             len(self._inflight) >= self.inflight_limit
@@ -582,9 +846,11 @@ class PagedEngine:
             # re-evaluates _live(), so remaining chunks drain right here.
         return done
 
-    def _reap(self, toks_dev, active_dev, slot_snapshot) -> List[Tuple[int, str]]:
+    def _reap(self, toks_dev, counts_dev, active_dev,
+              slot_snapshot) -> List[Tuple[int, str]]:
         """Read one chunk's results and finish the requests it completed."""
-        toks = np.asarray(toks_dev)      # [chunk, S] — the sync point
+        toks = np.asarray(toks_dev)      # [chunk, S(, k+1)] — the sync point
+        counts = None if counts_dev is None else np.asarray(counts_dev)
         active = np.asarray(active_dev)  # [S] int8 post-chunk active flags
         done: List[Tuple[int, str]] = []
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
@@ -595,7 +861,26 @@ class PagedEngine:
                 continue
             finished = False
             dead = not bool(active[slot])
-            for t in toks[:, slot]:
+            if counts is None:
+                # Plain step: one token per scan iteration; a dead slot's
+                # column holds pad filler (detected below).
+                stream, filler = toks[:, slot], True
+            else:
+                # Spec step: each scan iteration is a verify window; the
+                # first counts[c, slot] columns are its tokens in order
+                # (contiguous-prefix validity). Inactive windows emit
+                # nothing, so there is no filler to detect. Windows run
+                # while the request was live feed the acceptance stats.
+                col = counts[:, slot]
+                live = col > 0
+                self._spec_windows += int(np.sum(live))
+                self._spec_emitted += int(np.sum(col))
+                stream = [
+                    t for c in range(toks.shape[0])
+                    for t in toks[c, slot, : int(col[c])]
+                ]
+                filler = False
+            for t in stream:
                 tok = int(t)
                 if tok == eos:
                     # eos lands in the transcript when it's a distinct
@@ -605,12 +890,12 @@ class PagedEngine:
                         req.tokens.append(tok)
                     finished = True
                     break
-                if dead and tok == pad:
+                if filler and dead and tok == pad:
                     # Inactive-slot filler (the slot died at admission or
                     # in an earlier chunk, before any eos could appear in
                     # THIS chunk) — not content. Matters when pad != eos:
                     # without the device flag these pads would be appended
-                    # as answer tokens.
+                    # as answer tokens. Spec streams carry no filler.
                     finished = True
                     break
                 req.tokens.append(tok)
@@ -628,6 +913,7 @@ class PagedEngine:
                 finished = True
             if finished:
                 req.finished = True
+                self.total_generated_tokens += len(req.tokens)
                 text = self.tokenizer.decode(
                     [t for t in req.tokens if t != eos]
                 )
@@ -637,11 +923,8 @@ class PagedEngine:
                 # Kill the slot in the LIVE state (which may already be a
                 # chunk ahead): load-bearing for the host-side max_new/tmax
                 # caps, where the device still thinks the slot is active.
-                self.state = SlotState(
-                    cache=self.state.cache,
-                    tok=self.state.tok,
-                    active=self.state.active.at[slot].set(False),
-                    seen=self.state.seen,
+                self.state = self.state._replace(
+                    active=self.state.active.at[slot].set(False)
                 )
         return done
 

@@ -211,8 +211,11 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--spec-tokens", type=int, default=0,
         help="speculative decoding: verify this many prompt-lookup draft "
-        "tokens per step (engine/spec.py; exact — the output distribution "
-        "is unchanged). Best for low-batch latency serving; 0 = off",
+        "tokens per step (engine/draft.py kernels; exact — the output "
+        "distribution is unchanged). Works on both engines, including "
+        "--paged (per-slot verify windows; acceptance visible as the "
+        "spec_tokens_per_window gauge and spec_accepted_tokens counter "
+        "in /metrics). Best when per-step fixed costs dominate; 0 = off",
     )
     parser.add_argument("--max-new-tokens", type=int, default=128)
     parser.add_argument("--max-batch", type=int, default=8)
@@ -232,7 +235,8 @@ def main(argv=None) -> None:
                         "bucket)")
     parser.add_argument("--chunk", type=int, default=16,
                         help="paged engine tokens per dispatched step "
-                        "program; admission joins at chunk boundaries")
+                        "program (verify windows when --spec-tokens is "
+                        "set); admission joins at chunk boundaries")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
                              "ephemeral); omit to disable")
@@ -307,12 +311,11 @@ def main(argv=None) -> None:
         spec_tokens=args.spec_tokens,
     )
     if args.paged:
-        if args.spec_tokens:
-            parser.error("--spec-tokens applies to the group-batched "
-                         "engine; the paged engine decodes chunked "
-                         "single-token steps")
         # --max-batch bounds concurrency in both modes: it is the decode
         # slot count here (unless --slots overrides it explicitly).
+        # spec_tokens rides in on the EngineConfig: the paged engine
+        # verifies per-slot draft windows (chunk then counts verify
+        # WINDOWS per dispatch, up to spec_tokens+1 tokens each).
         engine = PagedEngine(config, slots=args.slots or args.max_batch,
                              chunk=args.chunk)
     else:

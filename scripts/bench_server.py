@@ -82,6 +82,7 @@ async def run(args) -> dict:
     else:
         engine = TutoringEngine(config)
     engine.warmup()
+    engine.total_generated_tokens = 0  # count only benched traffic
 
     # Same queue + servicer stack serve_async wires, but bound to an
     # ephemeral port the test can read back.
@@ -129,6 +130,9 @@ async def run(args) -> dict:
     answer_lat = sorted(x for lats in per_client for x in lats)
     n = len(answer_lat)
     ttft = snap["latency"].get("ttft", {})
+    import jax
+
+    n_chips = max(1, len(jax.devices()))
     return {
         "metric": "tutoring_server_ttft_p50_ms_under_concurrency",
         "value": round(ttft.get("p50_s", 0.0) * 1000, 2),
@@ -143,15 +147,22 @@ async def run(args) -> dict:
         "spec_tokens": args.spec_tokens,
         # Last completed batch's mean (the gauge is last-value); batch
         # counts here are small enough that it is representative, but it
-        # is a sample, not a run aggregate.
+        # is a sample, not a run aggregate. The counter IS an aggregate:
+        # tokens speculation produced beyond the guaranteed one/window.
         "spec_tokens_per_window": snap.get("gauges", {}).get(
             "spec_tokens_per_window"
+        ),
+        "spec_accepted_tokens": snap.get("counters", {}).get(
+            "spec_accepted_tokens"
         ),
         "ttft_p90_ms": round(ttft.get("p90_s", 0.0) * 1000, 2),
         "ttft_count": ttft.get("count", 0),
         "answer_p50_s": round(answer_lat[n // 2], 3),
         "answer_p95_s": round(answer_lat[min(int(n * 0.95), n - 1)], 3),
         "requests_per_s": round(n / wall, 2),
+        "tokens_per_sec_per_chip": round(
+            getattr(engine, "total_generated_tokens", 0) / wall / n_chips, 2
+        ),
         "wall_s": round(wall, 1),
     }
 
@@ -173,12 +184,10 @@ def main() -> None:
                     help="temperature-0 sampling (the speculative serving "
                          "configuration)")
     ap.add_argument("--spec-tokens", type=int, default=0,
-                    help="speculative decoding draft window (group-batched "
-                         "engine; exact)")
+                    help="speculative decoding draft window (exact; both "
+                         "engines — with --paged the step verifies per-slot "
+                         "draft windows)")
     args = ap.parse_args()
-    if args.paged and args.spec_tokens:
-        ap.error("--spec-tokens applies to the group-batched engine; the "
-                 "paged engine decodes chunked single-token steps")
     print(json.dumps(asyncio.run(run(args))))
 
 
