@@ -1,0 +1,35 @@
+"""dlrl-lint: repo-native static analysis for this codebase's bug classes.
+
+The two most expensive latent bugs this repo shipped were invisible to
+tests: a silent recompile-per-request from two spellings of the same
+replicated `PartitionSpec` (engine/paged._state_spec history), and
+resilience findings that sat unnoticed in `lms/service.py`. Production
+stacks encode such invariants as custom lint rules and runtime guards, not
+folklore — this package is the static half (the runtime half lives in
+`utils/guards.py`).
+
+Usage:
+    python scripts/lint.py [--json] [--rule NAME] [paths...]
+
+or in-process:
+    from distributed_lms_raft_llm_tpu.analysis import run_lint
+    findings = run_lint()
+
+Suppressions (see core.py for the grammar):
+    x = bad_thing()        # lint: disable=rule-name
+    # lint: disable-next=rule-name
+    x = bad_thing()
+    # lint: disable-file=rule-name        (anywhere in the file)
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Rule,
+    Source,
+    all_rules,
+    default_paths,
+    iter_sources,
+    register,
+    run_lint,
+)
+from . import rules  # noqa: F401  (importing registers every rule)

@@ -1,0 +1,193 @@
+"""Framework for repo-native AST lint rules.
+
+Generalizes the `scripts/audit_markers.py` pattern (one ad-hoc AST walk +
+a tier-1 test pinning the tree clean) into a registry of rules sharing:
+
+- one parse per file (`Source` carries text, lines, AST with parent links);
+- a suppression grammar (`# lint: disable=<rule>[,<rule>...]` on the
+  offending line, `# lint: disable-next=...` on the line above, or
+  `# lint: disable-file=...` anywhere) so sanctioned exceptions are
+  visible and attributable instead of silently special-cased in the rule;
+- a runner (`run_lint`) that `scripts/lint.py` and
+  `tests/test_lint_clean.py` share, so CI and the CLI can never disagree
+  about what "clean" means.
+
+Rules are pure AST/text analyses — nothing here imports the modules it
+checks, so the linter runs in milliseconds and cannot be broken by import
+side effects (JAX backend init, gRPC codegen, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Type
+
+# Matches "# lint: disable=a,b" / "disable-next=" / "disable-file=".
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*(disable(?:-next|-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)"
+)
+
+# Files never worth scanning: generated protobuf blobs and the lint
+# fixture corpus (known-bad snippets exercised by tests/test_lint_rules.py).
+EXCLUDE_PARTS = ("lint_fixtures",)
+EXCLUDE_NAMES = ("lms_pb2.py",)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str      # repo-relative, '/'-separated
+    line: int      # 1-based
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class Source:
+    """One parsed file: text, AST (with `.parent` links), suppressions."""
+
+    def __init__(self, path: Path, root: Optional[Path] = None,
+                 text: Optional[str] = None):
+        self.path = Path(path)
+        root = Path(root) if root is not None else None
+        try:
+            self.rel = (
+                self.path.resolve().relative_to(root.resolve()).as_posix()
+                if root is not None
+                else self.path.as_posix()
+            )
+        except ValueError:
+            self.rel = self.path.as_posix()
+        self.text = text if text is not None else self.path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(self.path))
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child.parent = node  # type: ignore[attr-defined]
+        self._line_suppressions: Dict[int, Set[str]] = {}
+        self._file_suppressions: Set[str] = set()
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        for lineno, line in enumerate(self.lines, start=1):
+            for mode, names in _SUPPRESS_RE.findall(line):
+                rules = {n.strip() for n in names.split(",") if n.strip()}
+                if mode == "disable-file":
+                    self._file_suppressions |= rules
+                elif mode == "disable-next":
+                    self._line_suppressions.setdefault(
+                        lineno + 1, set()
+                    ).update(rules)
+                else:
+                    self._line_suppressions.setdefault(lineno, set()).update(
+                        rules
+                    )
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_suppressions:
+            return True
+        return rule in self._line_suppressions.get(line, set())
+
+    # Convenience used by rules.
+    def parents(self, node: ast.AST) -> Iterable[ast.AST]:
+        """Ancestors of `node`, innermost first."""
+        cur = getattr(node, "parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "parent", None)
+
+
+class Rule:
+    """One check. Subclasses set `name`/`description` and implement
+    `check(src) -> [Finding]`; `applies_to(rel_path)` scopes which files
+    the runner hands them (tests may call `check` directly on any Source,
+    which is how fixture snippets exercise path-scoped rules)."""
+
+    name: str = ""
+    description: str = ""
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.endswith(".py")
+
+    def check(self, src: Source) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, src: Source, node_or_line, message: str) -> Finding:
+        line = (
+            node_or_line
+            if isinstance(node_or_line, int)
+            else getattr(node_or_line, "lineno", 0)
+        )
+        return Finding(rule=self.name, path=src.rel, line=line, message=message)
+
+
+_REGISTRY: List[Rule] = []
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding an instance to the global rule registry."""
+    _REGISTRY.append(cls())
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return list(_REGISTRY)
+
+
+def repo_root() -> Path:
+    """The repository root (two levels above this package)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def default_paths(root: Optional[Path] = None) -> List[Path]:
+    """What a full run covers: the package, the scripts, and the tests."""
+    root = root or repo_root()
+    return [
+        root / "distributed_lms_raft_llm_tpu",
+        root / "scripts",
+        root / "tests",
+    ]
+
+
+def iter_sources(
+    paths: Optional[Sequence[Path]] = None, root: Optional[Path] = None
+) -> List[Source]:
+    root = root or repo_root()
+    out: List[Source] = []
+    for base in paths or default_paths(root):
+        base = Path(base)
+        files = sorted(base.rglob("*.py")) if base.is_dir() else [base]
+        for path in files:
+            if path.name in EXCLUDE_NAMES:
+                continue
+            if any(part in EXCLUDE_PARTS for part in path.parts):
+                continue
+            out.append(Source(path, root=root))
+    return out
+
+
+def run_lint(
+    paths: Optional[Sequence[Path]] = None,
+    rules: Optional[Sequence[Rule]] = None,
+    root: Optional[Path] = None,
+) -> List[Finding]:
+    """Run `rules` (default: all registered) over `paths` (default: the
+    package + scripts + tests). Returns unsuppressed findings, sorted."""
+    root = root or repo_root()
+    active = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    for src in iter_sources(paths, root=root):
+        for rule in active:
+            if not rule.applies_to(src.rel):
+                continue
+            for f in rule.check(src):
+                if not src.suppressed(f.rule, f.line):
+                    findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

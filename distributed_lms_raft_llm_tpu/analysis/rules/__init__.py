@@ -1,0 +1,15 @@
+"""The rule catalog. Importing this package registers every rule.
+
+Each module holds one rule targeting one of this codebase's demonstrated
+bug classes (see the module docstrings for the incident each rule encodes).
+"""
+
+from . import (  # noqa: F401
+    async_blocking,
+    canonical_pspec,
+    guarded_by,
+    host_sync,
+    orphan_task,
+    slow_marker,
+    tracer_hygiene,
+)

@@ -1,0 +1,100 @@
+"""no-blocking-in-async: the event loop must never block.
+
+`raft/core.py` is sans-IO precisely so the whole consensus path can run as
+ONE asyncio task — but that design only holds if nothing on the loop
+blocks: a single `time.sleep`, sync file read, or device readback inside an
+`async def` stalls Raft ticks, heartbeats, commit waiters and every gRPC
+handler sharing the loop (the loop-stall watchdog in utils/guards.py is the
+runtime counterpart that measures exactly this).
+
+Flags, inside `async def` bodies anywhere in the tree:
+- `time.sleep(...)` (use `asyncio.sleep`);
+- builtin `open(...)` and `os.fdopen` (use `loop.run_in_executor`, as
+  `lms/service.py` does for blob IO);
+- `subprocess.run/call/check_output/check_call/Popen`;
+- `.result()` on futures (blocks a thread; await the future instead);
+- device readbacks — `jax.device_get`, `np.asarray`, `.item()`,
+  `.block_until_ready()` — which block on device compute.
+
+Nested sync `def`s inside an async function are skipped: they are
+frequently executor targets, and the executor is where blocking belongs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from ..core import Finding, Rule, Source, register
+
+_SUBPROCESS_FUNCS = {"run", "call", "check_output", "check_call", "Popen"}
+_READBACK_ATTRS = {"item", "block_until_ready"}
+
+
+def _async_body_nodes(fn: ast.AsyncFunctionDef) -> Iterator[ast.AST]:
+    """Nodes lexically in `fn`'s async body, excluding nested function
+    bodies (sync helpers are usually executor targets; nested async defs
+    are visited on their own by the caller's walk)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        yield node
+
+
+@register
+class BlockingInAsyncRule(Rule):
+    name = "no-blocking-in-async"
+    description = (
+        "blocking call (time.sleep / sync IO / .result() / device "
+        "readback) inside an async def — it stalls every task sharing the "
+        "event loop, Raft ticks included"
+    )
+
+    def check(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for inner in _async_body_nodes(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                label = self._blocking_label(inner)
+                if label is not None:
+                    findings.append(
+                        self.finding(
+                            src,
+                            inner,
+                            f"{label} blocks the event loop inside "
+                            f"`async def {node.name}`; await an async "
+                            "equivalent or run it in an executor",
+                        )
+                    )
+        return findings
+
+    @staticmethod
+    def _blocking_label(node: ast.Call) -> str | None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            base_name = base.id if isinstance(base, ast.Name) else None
+            if base_name == "time" and func.attr == "sleep":
+                return "time.sleep(...)"
+            if base_name == "os" and func.attr == "fdopen":
+                return "os.fdopen(...)"
+            if base_name == "subprocess" and func.attr in _SUBPROCESS_FUNCS:
+                return f"subprocess.{func.attr}(...)"
+            if base_name == "jax" and func.attr == "device_get":
+                return "jax.device_get(...)"
+            if base_name in ("np", "numpy") and func.attr in ("asarray", "array"):
+                return f"{base_name}.{func.attr}(...)"
+            if func.attr == "result" and not node.args:
+                return ".result()"
+            if func.attr in _READBACK_ATTRS and not node.args:
+                return f".{func.attr}()"
+        elif isinstance(func, ast.Name):
+            if func.id == "open":
+                return "open(...)"
+        return None

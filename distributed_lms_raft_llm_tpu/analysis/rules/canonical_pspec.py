@@ -1,0 +1,81 @@
+"""canonical-pspec: one spelling per replicated PartitionSpec.
+
+The PR-2 incident: `P()` and `P(None, None)` describe the SAME replicated
+layout, but the pjit cache keys on the spelling — two producers of one
+SlotState plane using different spellings made every (S, width) step
+program silently recompile on the first live request (tens of seconds of
+XLA per width, in production, after warmup claimed to have covered it).
+`engine/paged._state_spec` now canonicalizes at the dispatch boundary; this
+rule keeps new code from reintroducing the mixed-spelling hazard at the
+source: a literal trailing `None` in a `PartitionSpec(...)` / `P(...)`
+call is redundant (specs pad with None) and creates a second spelling of
+whatever the trailing-None-free form already says. `P(None, None)` is
+spelled `P()`, `P("tp", None)` is spelled `P("tp")`, and so on.
+
+Legitimate full-rank spellings (shard_map in_specs documenting every axis
+explicitly) carry a suppression with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, Rule, Source, register
+
+_PSPEC_NAMES = {"P", "PartitionSpec"}
+
+
+def _is_pspec_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in _PSPEC_NAMES
+    if isinstance(func, ast.Attribute):
+        return func.attr == "PartitionSpec"
+    return False
+
+
+def _canonical(args: List[ast.expr]) -> str:
+    kept = list(args)
+    while kept and isinstance(kept[-1], ast.Constant) and kept[-1].value is None:
+        kept.pop()
+    try:
+        inner = ", ".join(ast.unparse(a) for a in kept)
+    except Exception:  # pragma: no cover - unparse is best-effort detail
+        inner = "..."
+    return f"P({inner})"
+
+
+@register
+class CanonicalPSpecRule(Rule):
+    name = "canonical-pspec"
+    description = (
+        "PartitionSpec literals must not end in None: trailing Nones are a "
+        "second spelling of the same sharding, and spelling-keyed jit "
+        "caches silently recompile on the mismatch (the PR-2 bug class)"
+    )
+
+    def check(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not _is_pspec_call(node):
+                continue
+            # Starred construction (P(*dims)) is a computed spec — the
+            # canonicalizers build those on purpose; only literal trailing
+            # Nones are a spelling choice someone typed.
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue
+            if not node.args:
+                continue
+            last = node.args[-1]
+            if isinstance(last, ast.Constant) and last.value is None:
+                findings.append(
+                    self.finding(
+                        src,
+                        node,
+                        "non-canonical PartitionSpec spelling (trailing "
+                        f"None); write {_canonical(node.args)} so every "
+                        "producer of this layout shares one jit-cache key",
+                    )
+                )
+        return findings

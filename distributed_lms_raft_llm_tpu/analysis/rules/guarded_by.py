@@ -1,0 +1,278 @@
+"""guarded-by: annotated shared state only mutates under its lock.
+
+The convention (documented in the README):
+
+    class Metrics:
+        def __init__(self):
+            self._counters = {}          # guarded-by: _lock
+            self._lock = threading.Lock()
+
+Every mutation of `self._counters` anywhere in the class — assignment,
+augmented assignment, subscript store, `del`, or a mutating method call
+(`.append`, `.pop`, `.clear`, ...) — must then occur lexically inside
+`with self._lock:` (checked), inside a method whose `def` line carries the
+same `# guarded-by: _lock` annotation (meaning "callers hold the lock" —
+and calls to such methods are themselves checked to be under the lock), or
+inside `__init__` (construction happens-before sharing).
+
+Two special guard names cover the repo's lock-free confinement patterns:
+
+- `# guarded-by: event-loop` — asyncio-confined state (the batcher
+  queues). Checked property: the attribute is never mutated from inside a
+  function/lambda handed to `run_in_executor`, `executor.submit`, or
+  `threading.Thread` — the exact escape that would turn loop confinement
+  into a data race.
+- A guard name that names another attribute is assumed to be a
+  `threading.Lock`-like object used via `with self.<name>`.
+
+The check is lexical by design: it cannot prove the absence of races, but
+it turns "who guards this?" from tribal knowledge into a machine-checked
+annotation, which is what caught nothing before PR 1's review and would
+have caught it after.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional
+
+from ..core import Finding, Rule, Source, register
+
+_ANNOT_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w\-]*)")
+
+EVENT_LOOP = "event-loop"
+
+# Method names that mutate their receiver in place.
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "add", "discard", "setdefault", "sort", "reverse",
+    "appendleft", "popleft", "put_nowait",
+}
+
+_EXECUTOR_FUNCS = {"run_in_executor", "submit", "Thread", "Timer"}
+
+
+def _line_annotation(src: Source, lineno: int) -> Optional[str]:
+    """Annotation on the statement's line, or on a pure-comment line
+    directly above it (for declarations too long for a trailing comment)."""
+    if 1 <= lineno <= len(src.lines):
+        m = _ANNOT_RE.search(src.lines[lineno - 1])
+        if m:
+            return m.group(1)
+    if lineno >= 2:
+        above = src.lines[lineno - 2].strip()
+        if above.startswith("#"):
+            m = _ANNOT_RE.search(above)
+            if m:
+                return m.group(1)
+    return None
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.guards: Dict[str, str] = {}         # attr -> guard name
+        self.locked_methods: Dict[str, str] = {}  # method -> guard name
+
+
+def _collect(src: Source, cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(cls)
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            guard = _line_annotation(src, node.lineno)
+            if guard is None:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    info.guards[attr] = guard
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            guard = _line_annotation(src, node.lineno)
+            if guard is not None:
+                info.locked_methods[node.name] = guard
+    return info
+
+
+def _enclosing_method(src: Source, node: ast.AST,
+                      cls: ast.ClassDef) -> Optional[ast.AST]:
+    fn = None
+    for anc in src.parents(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = fn or anc
+        if anc is cls:
+            return fn
+    return fn
+
+
+def _under_lock(src: Source, node: ast.AST, lock: str,
+                info: _ClassInfo) -> bool:
+    for anc in src.parents(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                if _self_attr(expr) == lock:
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Inside a method annotated "callers hold this lock".
+            if info.locked_methods.get(anc.name) == lock:
+                return True
+            if anc.name == "__init__":
+                return True  # construction happens-before sharing
+            break  # left the method body; a lock further out doesn't count
+    return False
+
+
+def _escapes_to_thread(src: Source, node: ast.AST) -> bool:
+    """True when `node` sits in a def/lambda that is passed to an executor
+    or thread constructor (the loop-confinement escape hatch)."""
+    for anc in src.parents(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # A lambda is passed directly (its parent is the executor
+            # call); a def is referenced by name — look for the name as an
+            # argument to an executor call in the enclosing function.
+            parent = getattr(anc, "parent", None)
+            if isinstance(parent, ast.Call) and _is_executor_call(parent):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                outer = _outer_function(src, anc)
+                if outer is not None and _name_passed_to_executor(
+                    outer, anc.name
+                ):
+                    return True
+    return False
+
+
+def _is_executor_call(call: ast.Call) -> bool:
+    func = call.func
+    name = (
+        func.attr if isinstance(func, ast.Attribute)
+        else func.id if isinstance(func, ast.Name) else ""
+    )
+    return name in _EXECUTOR_FUNCS
+
+
+def _outer_function(src: Source, fn: ast.AST) -> Optional[ast.AST]:
+    for anc in src.parents(fn):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _name_passed_to_executor(scope: ast.AST, name: str) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and _is_executor_call(node):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    return True
+    return False
+
+
+@register
+class GuardedByRule(Rule):
+    name = "guarded-by"
+    description = (
+        "mutation of a `# guarded-by:` annotated attribute outside its "
+        "lock (`with self._lock:`), or an event-loop-confined attribute "
+        "mutated from executor/thread context"
+    )
+
+    def check(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(src.tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(self._check_class(src, cls))
+        return findings
+
+    def _check_class(self, src: Source, cls: ast.ClassDef) -> List[Finding]:
+        info = _collect(src, cls)
+        if not info.guards and not info.locked_methods:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(cls):
+            for attr, mutation in self._mutations(node):
+                guard = info.guards.get(attr)
+                if guard is None:
+                    continue
+                if guard == EVENT_LOOP:
+                    if _escapes_to_thread(src, node):
+                        findings.append(self.finding(
+                            src, node,
+                            f"self.{attr} is event-loop-confined "
+                            f"(guarded-by: {EVENT_LOOP}) but this {mutation} "
+                            "runs in executor/thread context — that is a "
+                            "data race with the loop",
+                        ))
+                elif not _under_lock(src, node, guard, info):
+                    findings.append(self.finding(
+                        src, node,
+                        f"{mutation} of self.{attr} outside `with "
+                        f"self.{guard}:` (declared guarded-by: {guard}); "
+                        "take the lock or annotate the enclosing method "
+                        f"`# guarded-by: {guard}` if callers hold it",
+                    ))
+            # Calls to lock-annotated methods must themselves hold the lock.
+            if isinstance(node, ast.Call):
+                method_attr = _self_attr(node.func)
+                if method_attr is not None:
+                    lock = info.locked_methods.get(method_attr)
+                    if lock is not None and lock != EVENT_LOOP and not \
+                            _under_lock(src, node, lock, info):
+                        findings.append(self.finding(
+                            src, node,
+                            f"self.{method_attr}() requires `{lock}` held "
+                            f"(its def is annotated guarded-by: {lock}) but "
+                            "this call site does not hold it",
+                        ))
+        return findings
+
+    @staticmethod
+    def _mutations(node: ast.AST):
+        """Yield (attr, description) for mutations of self.<attr>."""
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None and not isinstance(node, ast.Assign):
+                    yield attr, "augmented assignment"
+                elif attr is not None:
+                    # Plain rebinding in __init__ is the declaration; the
+                    # under-lock check exempts __init__ anyway.
+                    yield attr, "assignment"
+                # self._x[k] = v / self._x[k] += v
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        yield attr, "subscript store"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, "del"
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        yield attr, "subscript del"
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+                attr = _self_attr(func.value)
+                if attr is not None:
+                    yield attr, f".{func.attr}() call"

@@ -1,0 +1,108 @@
+"""no-orphan-task: every spawned task needs an owner; every coroutine an
+await.
+
+Two silent failure modes this rule pins down:
+
+1. Fire-and-forget `asyncio.ensure_future` / `create_task` whose handle is
+   dropped. The event loop holds tasks WEAKLY — a dropped handle can be
+   garbage-collected mid-flight, and its exception (if it survives long
+   enough to raise) is reported to nobody. `raft/grpc_transport._stub`'s
+   channel-close task was a live instance. The fix pattern is the one
+   `raft/node._pump` uses: keep the handle (list/set/attribute) and detach
+   it in a done callback, or `await` it.
+
+2. A bare expression statement calling an `async def` defined in the same
+   module/class without `await`: the coroutine object is created, never
+   scheduled, and the call silently does nothing (Python warns only at GC
+   time, into whatever stderr nobody watches).
+
+The rule accepts a spawn whose result is assigned, awaited, passed as an
+argument, or immediately chained (`.add_done_callback(...)`).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Finding, Rule, Source, register
+
+_SPAWN_FUNCS = {"ensure_future", "create_task"}
+
+
+def _local_async_names(tree: ast.Module) -> Set[str]:
+    """Names of async defs in this module: bare `foo` and method `bar` for
+    `async def bar` inside a class (matched via `self.bar(...)`)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            names.add(node.name)
+    return names
+
+
+def _call_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_local_coroutine_call(node: ast.Call, async_names: Set[str]) -> bool:
+    """`foo()` or `self.foo()` where foo is an async def in this module.
+    Calls through other receivers (`asyncio.run(...)`, `obj.close()`) are
+    out of scope: the receiver's type is unknown to a lexical pass."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in async_names
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "self"
+    ):
+        return func.attr in async_names
+    return False
+
+
+@register
+class OrphanTaskRule(Rule):
+    name = "no-orphan-task"
+    description = (
+        "spawned task handle dropped (weakly-held: may be GC'd mid-flight, "
+        "exceptions lost) or same-module coroutine called without await "
+        "(never runs at all)"
+    )
+
+    def check(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        async_names = _local_async_names(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            name = _call_name(value)
+            if name in _SPAWN_FUNCS:
+                findings.append(
+                    self.finding(
+                        src,
+                        value,
+                        f"{name}(...) handle dropped — the loop holds tasks "
+                        "weakly, so this task can be GC'd mid-flight and "
+                        "its exception is lost; keep the handle (and detach "
+                        "it in a done callback) or await it",
+                    )
+                )
+            elif _is_local_coroutine_call(value, async_names):
+                findings.append(
+                    self.finding(
+                        src,
+                        value,
+                        f"coroutine {name}(...) is never awaited — the call "
+                        "creates a coroutine object and drops it, so the "
+                        "body never runs",
+                    )
+                )
+        return findings

@@ -1,0 +1,99 @@
+"""slow-marker: soak-shaped tests must carry `@pytest.mark.slow`.
+
+The original repo-native rule (previously `scripts/audit_markers.py`, now a
+thin shim over this module): tier-1 runs `pytest -m 'not slow'` under a
+hard timeout, so ONE unmarked soak blows the whole budget. Any test
+function whose name advertises a long-running shape (`soak`, `sustained`,
+`stress_many`) must be marked slow — directly, on its class, or via a
+module-level `pytestmark`.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List
+
+from ..core import Finding, Rule, Source, register
+
+# Name fragments that mean "this test is a soak, not a unit test".
+SLOW_NAME_HINTS = ("soak", "sustained", "stress_many")
+
+
+def _is_slow_mark(node: ast.expr) -> bool:
+    """True for `pytest.mark.slow` / `mark.slow` (bare or called)."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    return isinstance(node, ast.Attribute) and node.attr == "slow"
+
+
+def _module_marked_slow(tree: ast.Module) -> bool:
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            targets = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+            if "pytestmark" in targets:
+                values = (
+                    stmt.value.elts
+                    if isinstance(stmt.value, (ast.List, ast.Tuple))
+                    else [stmt.value]
+                )
+                if any(_is_slow_mark(v) for v in values):
+                    return True
+    return False
+
+
+@register
+class SlowMarkerRule(Rule):
+    name = "slow-marker"
+    description = (
+        "test whose name advertises a soak shape (soak/sustained/"
+        "stress_many) lacks @pytest.mark.slow — it would blow the tier-1 "
+        "timeout"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        path = Path(rel)
+        return path.name.startswith("test_") and path.suffix == ".py"
+
+    def check(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        module_slow = _module_marked_slow(src.tree)
+
+        def visit(body, class_slow: bool) -> None:
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    cls_slow = class_slow or any(
+                        _is_slow_mark(d) for d in node.decorator_list
+                    )
+                    visit(node.body, cls_slow)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if not node.name.startswith("test_"):
+                        continue
+                    hints = [h for h in SLOW_NAME_HINTS if h in node.name]
+                    if not hints:
+                        continue
+                    fn_slow = any(
+                        _is_slow_mark(d) for d in node.decorator_list
+                    )
+                    if not (fn_slow or class_slow or module_slow):
+                        findings.append(self.finding(
+                            src, node,
+                            f"{node.name} looks like a soak (name hints: "
+                            f"{hints}) but lacks @pytest.mark.slow",
+                        ))
+
+        visit(src.tree.body, class_slow=False)
+        return findings
+
+
+def audit(tests_dir) -> List[str]:
+    """Back-compat API for `scripts/audit_markers.py` and
+    `tests/test_marker_audit.py`: violation strings, old format."""
+    rule = SlowMarkerRule()
+    out: List[str] = []
+    for path in sorted(Path(tests_dir).glob("test_*.py")):
+        src = Source(path, root=Path(tests_dir))
+        for f in rule.check(src):
+            if not src.suppressed(f.rule, f.line):
+                out.append(f"{path.name}::{f.message}")
+    return out

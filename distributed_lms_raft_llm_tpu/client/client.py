@@ -28,7 +28,12 @@ from typing import Callable, Dict, List, Optional, Sequence, TypeVar
 import grpc
 
 from ..proto import lms_pb2, rpc
-from ..utils.resilience import Deadline, DeadlineExpired, jittered_backoff
+from ..utils.resilience import (
+    REQUEST_ID_METADATA_KEY,
+    Deadline,
+    DeadlineExpired,
+    jittered_backoff,
+)
 
 log = logging.getLogger(__name__)
 
@@ -204,8 +209,15 @@ class LMSClient:
         return uuid.uuid4().hex
 
     @staticmethod
-    def _md(deadline: Optional[Deadline]):
-        return deadline.to_metadata() if deadline is not None else None
+    def _md(deadline: Optional[Deadline], request_id: Optional[str] = None):
+        """Per-attempt metadata: the live deadline budget, plus (when given)
+        the logical request id — the SAME id on every retry, so server-side
+        mutations made on this request's behalf (the degraded instructor
+        fallback) dedupe in the replicated applier."""
+        md = deadline.to_metadata() if deadline is not None else []
+        if request_id:
+            md = md + [(REQUEST_ID_METADATA_KEY, request_id)]
+        return md or None
 
     # ----------------------------------------------------------------- api
 
@@ -356,11 +368,16 @@ class LMSClient:
         """One student query under one overall budget (default
         `llm_timeout_s`). The LMS forwards the remaining budget to the
         tutoring node; if tutoring is down or too slow the LMS answers
-        degraded (query queued for an instructor) within the budget."""
+        degraded (query queued for an instructor) within the budget.
+
+        One `request_id` spans ALL retries of this logical call: a retry
+        whose earlier attempt already queued the degraded instructor entry
+        must not queue a second one (ROADMAP item a)."""
+        rid = self._request_id()
         return self._call(
             lambda s, t, d: s.GetLLMAnswer(
                 lms_pb2.QueryRequest(token=self.token or "", query=query),
-                timeout=t, metadata=self._md(d),
+                timeout=t, metadata=self._md(d, request_id=rid),
             ),
             budget_s=budget_s or self.llm_timeout_s,
             attempt_cap_s=None,

@@ -51,9 +51,13 @@ class BatchingQueue:
         self.max_wait_s = max_wait_ms / 1000.0
         self.metrics = metrics
         self.max_queue = max_queue  # 0 = unbounded (legacy behavior)
-        self._queue: asyncio.Queue[_Item] = asyncio.Queue()
-        self._runner: Optional[asyncio.Task] = None
-        self._closed = False
+        # Loop-confined state: everything below is touched only from
+        # coroutines on the serving loop — the engine call is the ONLY
+        # thing that leaves the loop (run_in_executor), and it receives
+        # plain prompts, never these containers.
+        self._queue: asyncio.Queue[_Item] = asyncio.Queue()  # guarded-by: event-loop
+        self._runner: Optional[asyncio.Task] = None  # guarded-by: event-loop
+        self._closed = False                         # guarded-by: event-loop
 
     def _inc(self, name: str) -> None:
         if self.metrics is not None:
@@ -202,13 +206,16 @@ class PagedQueue:
         self.engine = engine
         self.metrics = metrics
         self.max_queue = max_queue  # bound on not-yet-admitted requests
-        self._incoming: asyncio.Queue[_Item] = asyncio.Queue()
-        self._futures: Dict[int, asyncio.Future] = {}
+        # Loop-confined (see BatchingQueue): the engine's step() runs in an
+        # executor thread, but it never sees these containers — admissions
+        # and reaps happen on the runner coroutine between steps.
+        self._incoming: asyncio.Queue[_Item] = asyncio.Queue()  # guarded-by: event-loop
+        self._futures: Dict[int, asyncio.Future] = {}  # guarded-by: event-loop
         # rid -> deadline for requests sitting in the ENGINE's pending list
         # (handed over by _admit but no slot yet — prefill hasn't run).
-        self._pending_deadlines: Dict[int, Deadline] = {}
-        self._runner: Optional[asyncio.Task] = None
-        self._closed = False
+        self._pending_deadlines: Dict[int, Deadline] = {}  # guarded-by: event-loop
+        self._runner: Optional[asyncio.Task] = None  # guarded-by: event-loop
+        self._closed = False                         # guarded-by: event-loop
 
     @property
     def waiting(self) -> int:

@@ -34,6 +34,7 @@ from ..parallel import mesh as mesh_lib
 from ..parallel import partition
 from ..utils import tokenizer as tok_lib
 from ..utils.compilation import enable_compilation_cache
+from ..utils.guards import intended_transfer
 from .generate import GenerateResult, decode, pick_bucket, prefill
 from .sampling import SamplingParams
 
@@ -245,8 +246,11 @@ class TutoringEngine:
         if self._pending_spec_stats is not None:
             windows, lengths, n = self._pending_spec_stats
             self._pending_spec_stats = None
-            w = max(1, int(jax.device_get(windows)))
-            lengths = np.asarray(jax.device_get(lengths))
+            # Deferred gauge resolution — the pipelined dispatch path never
+            # blocked for these; by now the computation has long finished.
+            with intended_transfer():
+                w = max(1, int(jax.device_get(windows)))
+                lengths = np.asarray(jax.device_get(lengths))
             self._last_spec_tpw = float(
                 (np.sum(lengths[:n]) - n) / (w * n)
             )
@@ -338,7 +342,8 @@ class TutoringEngine:
             state = self._prefill(self.params, input_ids=jnp.asarray(ids),
                                   prompt_mask=jnp.asarray(mask), rng=rng)
             if measure_ttft:
-                np.asarray(state.out[:, 0])  # blocks until the first token exists
+                with intended_transfer():  # blocks until the token exists
+                    np.asarray(state.out[:, 0])
                 self.last_ttft_s = time.monotonic() - t0
             # The final state is returned (and dropped) so the donated input
             # state's same-shaped buffers (out/seen/rng/flags) alias into the
@@ -356,8 +361,9 @@ class TutoringEngine:
                     # windows) — the honest aggregate. Only the first
                     # `real_rows` count: batch-bucket filler rows'
                     # degenerate speculation must not skew the reading.
-                    windows = max(1, int(jax.device_get(fin.windows)))
-                    result = jax.device_get(result)
+                    with intended_transfer():
+                        windows = max(1, int(jax.device_get(fin.windows)))
+                        result = jax.device_get(result)
                     self.last_spec_tokens_per_window = float(
                         (np.sum(result.lengths[:n]) - n) / (windows * n)
                     )
@@ -368,7 +374,10 @@ class TutoringEngine:
                 self._pending_spec_stats = (fin.windows, result.lengths, n)
             else:
                 result, _ = self._decode(self.params, state)
-        return result if device_result else jax.device_get(result)
+        if device_result:
+            return result
+        with intended_transfer():  # the call's one sanctioned readback
+            return jax.device_get(result)
 
     def score(self, texts: Sequence[str]) -> List[dict]:
         """Log-likelihood scoring: per text, the total next-token log
@@ -461,7 +470,7 @@ class TutoringEngine:
 
             self._score_fn = jax.jit(score_fn)
 
-        with self.mesh:
+        with self.mesh, intended_transfer():
             total, count = jax.device_get(
                 self._score_fn(self.params, jnp.asarray(ids),
                                jnp.asarray(mask))
@@ -500,6 +509,8 @@ class TutoringEngine:
             for i in range(len(chunk)):
                 n = int(result.lengths[i])
                 self.total_generated_tokens += n
+                # Host-side numpy after generate_ids' readback, not a
+                # device sync.  # lint: disable-next=no-host-sync-in-dispatch
                 toks = [t for t in result.tokens[i, :n].tolist()
                         if t != self.tokenizer.eos_id]
                 answers.append(self.tokenizer.decode(toks))

@@ -77,7 +77,7 @@ class RelevanceGate:
         # entries concurrent misses just computed.
         import threading
 
-        self._ctx_cache: dict = {}
+        self._ctx_cache: dict = {}  # guarded-by: _ctx_lock
         self._ctx_lock = threading.Lock()
 
     def _encode(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:

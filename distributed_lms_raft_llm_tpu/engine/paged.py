@@ -54,6 +54,7 @@ from ..parallel import mesh as mesh_lib
 from ..parallel import partition
 from ..utils import tokenizer as tok_lib
 from ..utils.compilation import enable_compilation_cache
+from ..utils.guards import intended_transfer
 from .draft import build_drafts, verify_window
 from .engine import EngineConfig
 from .generate import pick_bucket
@@ -96,7 +97,9 @@ class _Request:
 
 
 def _state_spec(x: jax.Array) -> jax.sharding.PartitionSpec:
-    """The canonical replicated-spec SPELLING for a SlotState plane.
+    """The canonical replicated-spec SPELLING for a SlotState plane: `P()`
+    at every rank (trailing Nones dropped — the same canonical form the
+    `canonical-pspec` lint rule enforces on source literals).
 
     Different producers of the same SlotState leaf (install's scatter,
     grow's pad, the step scan, reap's eager active-kill) let GSPMD pick
@@ -108,14 +111,17 @@ def _state_spec(x: jax.Array) -> jax.sharding.PartitionSpec:
     engine therefore respells the host-state planes to one canonical
     spec at every step-dispatch boundary (`_canon_state` — a zero-copy
     Array rewrap), making each (S, k, width) step program compile
-    exactly once: guarded by tests/test_paged_spec.py. The KV cache k/v
-    planes are never touched: their sharding belongs to the partitioner
-    (tp meshes shard the heads axis), and a device_put against a
-    non-equivalent sharding would be a real reshard, not a rewrap.
+    exactly once: guarded by tests/test_paged_spec.py. The spelling must
+    match what the compiled programs themselves emit, which follows the
+    partition rules' spelling (parallel/partition.py, canonical since
+    the canonical-pspec sweep) — with everything agreeing on `P()`, the
+    steady state rewraps nothing. The KV cache k/v planes are never
+    touched: their sharding belongs to the partitioner (tp meshes shard
+    the heads axis), and a device_put against a non-equivalent sharding
+    would be a real reshard, not a rewrap.
     """
-    if x.ndim < 2:
-        return jax.sharding.PartitionSpec()
-    return jax.sharding.PartitionSpec(*([None] * x.ndim))
+    del x  # replicated at any rank spells the same way
+    return jax.sharding.PartitionSpec()
 
 
 def _prefill_program(params, ids, true_len, rng, *, cfg, sampling, model):
@@ -752,7 +758,8 @@ class PagedEngine:
             admitted.append((slot, req, first))
         if not admitted:
             return
-        firsts = jax.device_get([f for _, _, f in admitted])  # one sync
+        with intended_transfer():  # ONE sync for the whole admitted group
+            firsts = jax.device_get([f for _, _, f in admitted])
         now = time.monotonic()
         for (slot, req, _), first in zip(admitted, firsts):
             req.tokens = [int(first)]
@@ -849,9 +856,10 @@ class PagedEngine:
     def _reap(self, toks_dev, counts_dev, active_dev,
               slot_snapshot) -> List[Tuple[int, str]]:
         """Read one chunk's results and finish the requests it completed."""
-        toks = np.asarray(toks_dev)      # [chunk, S(, k+1)] — the sync point
-        counts = None if counts_dev is None else np.asarray(counts_dev)
-        active = np.asarray(active_dev)  # [S] int8 post-chunk active flags
+        with intended_transfer():  # THE sync point of the engine loop
+            toks = np.asarray(toks_dev)  # [chunk, S(, k+1)]
+            counts = None if counts_dev is None else np.asarray(counts_dev)
+            active = np.asarray(active_dev)  # [S] int8 post-chunk flags
         done: List[Tuple[int, str]] = []
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
         for slot, req in enumerate(slot_snapshot):

@@ -24,6 +24,7 @@ from typing import Dict, Optional
 from ..raft import FileStorage, RaftConfig, RaftNode, decode_command
 from ..raft.grpc_transport import GrpcTransport
 from ..raft.messages import Entry
+from ..utils.guards import make_tick_watchdog
 from .persistence import BlobStore, SnapshotStore
 from .service import replicate_file_to_peers
 from .state import LMSState
@@ -42,6 +43,7 @@ class LMSNode:
         transport=None,
         snapshot_every: int = 64,
         fault_injector=None,
+        metrics=None,
     ):
         # snapshot_every > 1 amortizes the full-state JSON rewrite (the WAL
         # already guarantees durability; on crash, at most snapshot_every
@@ -64,6 +66,7 @@ class LMSNode:
             from ..utils.faults import FaultyTransport
 
             transport = FaultyTransport(transport, fault_injector)
+        cfg = raft_config or RaftConfig()
         self.node = RaftNode(
             node_id,
             # id -> address mapping seeds raft membership; a durable
@@ -75,6 +78,13 @@ class LMSNode:
             install_cb=self._install_snapshot,
             config=raft_config,
             last_applied=applied,
+            # Tick-lag watchdog (utils/guards.py): loop stalls export via
+            # /metrics as raft_tick_lag/raft_tick_stalls. Warn threshold
+            # tracks the heartbeat interval — a stall that long delays
+            # heartbeats and risks spurious elections.
+            watchdog=make_tick_watchdog(
+                metrics, tick_interval=cfg.heartbeat_interval
+            ),
         )
         # Keep the file-replication peer list in sync with raft membership
         # (a server added at runtime receives blob anti-entropy too).

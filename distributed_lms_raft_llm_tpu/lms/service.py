@@ -39,7 +39,11 @@ from ..utils import pdf
 from ..utils.auth import sign_query
 from ..utils.faults import FaultInjected, FaultInjector
 from ..utils.metrics import Metrics
-from ..utils.resilience import CircuitBreaker, Deadline
+from ..utils.resilience import (
+    CircuitBreaker,
+    Deadline,
+    request_id_from_grpc_context,
+)
 from .persistence import BlobStore
 from .state import LMSState, hash_password
 
@@ -100,7 +104,7 @@ class LMSServicer(rpc.LMSServicer):
         # Negative cache: rel_path -> monotonic deadline before which peer
         # fetches are not retried. Without it, every read referencing a
         # permanently lost blob would stall on a full peer sweep.
-        self._blob_missing: Dict[str, float] = {}
+        self._blob_missing: Dict[str, float] = {}  # guarded-by: event-loop
 
     # ------------------------------------------------------------- helpers
 
@@ -165,12 +169,21 @@ class LMSServicer(rpc.LMSServicer):
             "tutoring_breaker_state", CircuitBreaker._STATE_CODES[new]
         )
 
-    async def _degraded_answer(self, username: str, query: str, reason: str):
+    async def _degraded_answer(self, username: str, query: str, reason: str,
+                               request_id: Optional[str] = None):
         """Tutoring unusable (breaker open / budget gone / RPC failed):
         fall back to the reference's human path — replicate the query onto
         the instructor queue and tell the student so. The answer degrades;
         the request never hangs or errors while the cluster is otherwise
-        healthy."""
+        healthy.
+
+        `request_id` is the CLIENT's logical-request id (x-request-id
+        metadata, one per ask_llm across all its retries): keying the
+        fallback on it lets the replicated applier drop the duplicate when
+        a retried attempt degrades again — one instructor entry per logical
+        question, not per attempt. Clients that send no id fall back to a
+        fresh id per attempt (the old, duplicate-prone behavior, but only
+        for clients that opted out of idempotency)."""
         self.metrics.inc("tutoring_degraded")
         log.warning("tutoring degraded (%s); queueing for instructor", reason)
         try:
@@ -178,7 +191,7 @@ class LMSServicer(rpc.LMSServicer):
                 encode_command(
                     "AskQuery",
                     {"username": username, "query": query,
-                     "request_id": uuid.uuid4().hex},
+                     "request_id": request_id or uuid.uuid4().hex},
                 )
             )
         except (NotLeader, TransferInFlight, TimeoutError, RuntimeError) as e:
@@ -507,6 +520,10 @@ class LMSServicer(rpc.LMSServicer):
     async def GetLLMAnswer(self, request, context):
         await self._read_fence(context)
         self.metrics.inc("llm_requests")
+        # One logical ask_llm = one id across all client retries (metadata;
+        # the frozen QueryRequest has no field for it). Threads into the
+        # degraded fallback so retries never double-queue the instructor.
+        client_rid = request_id_from_grpc_context(context)
         auth = self._auth(request.token)
         if auth is None:
             return lms_pb2.QueryResponse(success=False, response="Invalid session")
@@ -556,12 +573,14 @@ class LMSServicer(rpc.LMSServicer):
             if deadline is not None and budget <= self._deadline_floor_s:
                 self.metrics.inc("tutoring_budget_exhausted")
                 return await self._degraded_answer(
-                    username, request.query, "deadline budget exhausted"
+                    username, request.query, "deadline budget exhausted",
+                    request_id=client_rid,
                 )
             if not self.tutoring_breaker.allow():
                 self.metrics.inc("tutoring_breaker_rejections")
                 return await self._degraded_answer(
-                    username, request.query, "circuit open"
+                    username, request.query, "circuit open",
+                    request_id=client_rid,
                 )
             # With a shared key configured, the forwarded query carries an
             # HMAC ticket in the token field; the tutoring node answers only
@@ -587,6 +606,33 @@ class LMSServicer(rpc.LMSServicer):
                     metadata=(deadline.to_metadata()
                               if deadline is not None else None),
                 )
+                if plan is not None and plan.duplicate:
+                    # Deliver the query twice, like FaultyTransport does
+                    # for Raft RPCs: the hop is a pure read/compute, so a
+                    # duplicate must only cost compute, never change the
+                    # answer's success — verified over real gRPC by the
+                    # chaos soak. Counted so snapshot()'s injected_total
+                    # matches faults that actually happened (ROADMAP
+                    # item b: this used to be a silent no-op that still
+                    # counted as injected). The re-send failing (e.g. the
+                    # remaining budget is gone) must not discard the
+                    # successful first answer, so it has its own handler.
+                    self.metrics.inc("tutoring_duplicates")
+                    if deadline is not None:
+                        budget = deadline.timeout(cap=self._tutoring_timeout_s)
+                    try:
+                        answer = await stub.GetLLMAnswer(
+                            lms_pb2.QueryRequest(
+                                token=fwd_token, query=request.query
+                            ),
+                            timeout=max(0.001, budget - self._deadline_floor_s)
+                            if deadline is not None else budget,
+                            metadata=(deadline.to_metadata()
+                                      if deadline is not None else None),
+                        )
+                    except grpc.RpcError as e:
+                        log.info("duplicate delivery failed (%s); keeping "
+                                 "the first answer", e.code())
                 if plan is not None and plan.error:
                     raise FaultInjected("injected response loss <- tutoring")
             except (grpc.RpcError, FaultInjected) as e:
@@ -595,7 +641,9 @@ class LMSServicer(rpc.LMSServicer):
                 self.metrics.inc("tutoring_failures")
                 self.tutoring_breaker.record_failure()
                 return await self._degraded_answer(
-                    username, request.query, f"tutoring RPC failed ({code or e})"
+                    username, request.query,
+                    f"tutoring RPC failed ({code or e})",
+                    request_id=client_rid,
                 )
             self.tutoring_breaker.record_success()
         return answer

@@ -33,22 +33,28 @@ Rules = Sequence[Tuple[str, PartitionSpec]]
 # over tp and row-parallel leaves replicate them (the scale applies after
 # the tp psum). Embedding tables scale per ROW (quantize_embedding), so
 # their `s` is [V], vocab-sharded like `q`'s leading axis.
+#
+# Spelling: trailing Nones are dropped everywhere (P() not P(None, None),
+# P(None, "tp") not P(None, "tp", None)) — PartitionSpec pads with None,
+# and one canonical spelling per layout keeps spelling-keyed jit caches
+# from silently recompiling (the canonical-pspec lint rule enforces this;
+# see engine/paged._state_spec for the incident).
 GPT2_RULES: List[Tuple[str, PartitionSpec]] = [
-    (r"wte(/q)?$", P("tp", None)),       # vocab-sharded embedding
+    (r"wte(/q)?$", P("tp")),       # vocab-sharded embedding
     (r"wte/s$", P("tp")),
-    (r"wpe$", P(None, None)),
+    (r"wpe$", P()),
     (r"blocks/attn/wqkv(/q)?$", P(None, None, "tp")),   # column parallel
     (r"blocks/attn/wqkv/s$", P(None, "tp")),
     (r"blocks/attn/bqkv$", P(None, "tp")),
-    (r"blocks/attn/wo(/q)?$", P(None, "tp", None)),     # row parallel
-    (r"blocks/attn/wo/s$", P(None, None)),
-    (r"blocks/attn/bo$", P(None, None)),
+    (r"blocks/attn/wo(/q)?$", P(None, "tp")),     # row parallel
+    (r"blocks/attn/wo/s$", P()),
+    (r"blocks/attn/bo$", P()),
     (r"blocks/mlp/wi(/q)?$", P(None, None, "tp")),
     (r"blocks/mlp/wi/s$", P(None, "tp")),
     (r"blocks/mlp/bi$", P(None, "tp")),
-    (r"blocks/mlp/wo(/q)?$", P(None, "tp", None)),
-    (r"blocks/mlp/wo/s$", P(None, None)),
-    (r"blocks/mlp/bo$", P(None, None)),
+    (r"blocks/mlp/wo(/q)?$", P(None, "tp")),
+    (r"blocks/mlp/wo/s$", P()),
+    (r"blocks/mlp/bo$", P()),
     (r"ln|lnf", P()),                    # norms replicated
     (r".*", P()),
 ]
@@ -56,35 +62,35 @@ GPT2_RULES: List[Tuple[str, PartitionSpec]] = [
 # Llama family: Megatron TP like GPT-2; q/k/v/gate/up column-parallel,
 # o/down row-parallel; untied vocab-sharded embed + lm_head.
 LLAMA_RULES: List[Tuple[str, PartitionSpec]] = [
-    (r"embed(/q)?$", P("tp", None)),
+    (r"embed(/q)?$", P("tp")),
     (r"embed/s$", P("tp")),
-    (r"lm_head(/q)?$", P("tp", None)),
+    (r"lm_head(/q)?$", P("tp")),
     (r"lm_head/s$", P("tp")),
     (r"blocks/attn/w[qkv](/q)?$", P(None, None, "tp")),
     (r"blocks/attn/w[qkv]/s$", P(None, "tp")),
-    (r"blocks/attn/wo(/q)?$", P(None, "tp", None)),
-    (r"blocks/attn/wo/s$", P(None, None)),
+    (r"blocks/attn/wo(/q)?$", P(None, "tp")),
+    (r"blocks/attn/wo/s$", P()),
     (r"blocks/mlp/w[gu](/q)?$", P(None, None, "tp")),
     (r"blocks/mlp/w[gu]/s$", P(None, "tp")),
-    (r"blocks/mlp/wd(/q)?$", P(None, "tp", None)),
-    (r"blocks/mlp/wd/s$", P(None, None)),
+    (r"blocks/mlp/wd(/q)?$", P(None, "tp")),
+    (r"blocks/mlp/wd/s$", P()),
     (r"ln|lnf", P()),
     (r".*", P()),
 ]
 
 BERT_RULES: List[Tuple[str, PartitionSpec]] = [
-    (r"embeddings/word(/q)?$", P("tp", None)),
+    (r"embeddings/word(/q)?$", P("tp")),
     (r"embeddings/word/s$", P("tp")),
-    (r"embeddings/(position|token_type)$", P(None, None)),
+    (r"embeddings/(position|token_type)$", P()),
     (r"blocks/attn/wqkv(/q)?$", P(None, None, "tp")),
     (r"blocks/attn/wqkv/s$", P(None, "tp")),
     (r"blocks/attn/bqkv$", P(None, "tp")),
-    (r"blocks/attn/wo(/q)?$", P(None, "tp", None)),
-    (r"blocks/attn/wo/s$", P(None, None)),
+    (r"blocks/attn/wo(/q)?$", P(None, "tp")),
+    (r"blocks/attn/wo/s$", P()),
     (r"blocks/mlp/wi(/q)?$", P(None, None, "tp")),
     (r"blocks/mlp/wi/s$", P(None, "tp")),
-    (r"blocks/mlp/wo(/q)?$", P(None, "tp", None)),
-    (r"blocks/mlp/wo/s$", P(None, None)),
+    (r"blocks/mlp/wo(/q)?$", P(None, "tp")),
+    (r"blocks/mlp/wo/s$", P()),
     (r".*", P()),
 ]
 
@@ -94,10 +100,10 @@ BERT_RULES: List[Tuple[str, PartitionSpec]] = [
 # each expert's FFN on its shard and insert the all-to-alls, exactly as
 # the tp specs imply the Megatron psums. The tiny router is replicated.
 MOE_RULES: List[Tuple[str, PartitionSpec]] = [
-    (r"blocks/moe/wr$", P(None, None, None)),
-    (r"blocks/moe/w[io](/q)?$", P(None, "ep", None, None)),
-    (r"blocks/moe/w[io]/s$", P(None, "ep", None)),
-    (r"blocks/moe/b[io]$", P(None, "ep", None)),
+    (r"blocks/moe/wr$", P()),
+    (r"blocks/moe/w[io](/q)?$", P(None, "ep")),
+    (r"blocks/moe/w[io]/s$", P(None, "ep")),
+    (r"blocks/moe/b[io]$", P(None, "ep")),
 ] + GPT2_RULES
 
 # Rule set per model-family name (models/registry.py ModelFamily.name).

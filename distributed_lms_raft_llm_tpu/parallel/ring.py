@@ -128,6 +128,9 @@ def ring_attention(
     `spec`, default [B over dp, H over tp, T over sp]).
     """
     scale = 1.0 / (q.shape[-1] ** 0.5)
+    # shard_map in_specs spell every axis of the [B, H, T, Dh] operand
+    # explicitly (rank documentation, and these specs never key a jit
+    # cache).  # lint: disable-next=canonical-pspec
     spec = spec or P("dp", "tp", axis_name, None)
     fn = shard_map(
         functools.partial(

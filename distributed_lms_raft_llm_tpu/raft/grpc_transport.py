@@ -140,6 +140,10 @@ class GrpcTransport(Transport):
         self._stubs: Dict[int, rpc.RaftServiceStub] = {}
         self._channels: Dict[int, grpc.aio.Channel] = {}
         self._dialed: Dict[int, str] = {}  # address each channel went to
+        # Stale-channel close tasks in flight: the loop holds tasks weakly,
+        # so a dropped handle could be GC'd before the close completes and
+        # would report its exception to nobody (no-orphan-task rule).
+        self._closing: set = set()
 
     def _stub(self, peer: int) -> rpc.RaftServiceStub:
         # Re-dial when a runtime membership change moved the peer (the
@@ -148,7 +152,9 @@ class GrpcTransport(Transport):
         if peer in self._stubs and self._dialed[peer] != self.addresses[peer]:
             old = self._channels.pop(peer)
             self._stubs.pop(peer)
-            asyncio.ensure_future(old.close(None))
+            task = asyncio.ensure_future(old.close(None))
+            self._closing.add(task)
+            task.add_done_callback(self._closing.discard)
         if peer not in self._stubs:
             address = self.addresses[peer]
             channel = grpc.aio.insecure_channel(address)
@@ -197,6 +203,14 @@ class GrpcTransport(Transport):
             await channel.close()
         self._channels.clear()
         self._stubs.clear()
+        # Settle any stale-channel closes still in flight (snapshot: done
+        # callbacks mutate the set as tasks finish).
+        for task in list(self._closing):
+            try:
+                await task
+            except Exception:  # a failed close of a stale channel is moot
+                pass
+        self._closing.clear()
 
 
 # -------------------------------- servicer ---------------------------------

@@ -70,6 +70,7 @@ class RaftNode:
         tick_interval: float = 0.01,
         seed: Optional[int] = None,
         last_applied: int = 0,
+        watchdog=None,  # utils.guards.LoopWatchdog (optional)
     ):
         self.core = RaftCore(
             node_id, peer_ids, storage, config, now=time.monotonic(), seed=seed,
@@ -79,6 +80,12 @@ class RaftNode:
         self.apply_cb = apply_cb
         self.install_cb = install_cb
         self.tick_interval = tick_interval
+        # Loop-stall watchdog: the tick loop reports its scheduling lag so
+        # anything blocking this event loop (sync IO, a device readback, a
+        # long apply) is visible as the `raft_tick_lag` histogram and
+        # `raft_tick_stalls` counter in /metrics instead of as mystery
+        # election churn.
+        self.watchdog = watchdog
         # index -> [(expected_term, future)]: a waiter only resolves if the
         # entry committed at its index carries the term it was proposed in —
         # otherwise a new leader's different entry at the same index would be
@@ -247,10 +254,18 @@ class RaftNode:
     # ------------------------------------------------------------ internals
 
     async def _tick_loop(self) -> None:
+        # Lag is measured over the WHOLE iteration (tick + pump + sleep), so
+        # both a slow apply callback and another task hogging the loop show
+        # up — not just oversleep.
+        prev = time.monotonic()
         while not self._stopped:
             self.core.tick(time.monotonic())
             self._pump()
             await asyncio.sleep(self.tick_interval)
+            now = time.monotonic()
+            if self.watchdog is not None:
+                self.watchdog.observe(now - prev - self.tick_interval)
+            prev = now
 
     def _sync_transport_addresses(self) -> None:
         """Push membership addresses into an address-keyed transport (the

@@ -42,6 +42,11 @@ from ..utils.resilience import CircuitBreaker
 log = logging.getLogger("lms_server")
 
 
+def _read_text(path: str) -> str:
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
 def parse_addresses(peers, host: str) -> Dict[int, str]:
     addresses = {}
     for i, peer in enumerate(peers, start=1):
@@ -63,9 +68,13 @@ async def serve_async(args) -> None:
     # tutoring forward); dormant (zero overhead beyond a dict probe) until
     # the admin endpoint installs a spec.
     faults = FaultInjector(seed=args.fault_seed)
+    metrics = Metrics()
     lms_node = LMSNode(
         args.id, addresses, args.data_dir, raft_config=raft_config,
         snapshot_every=args.snapshot_every, fault_injector=faults,
+        # Wires the Raft tick-lag watchdog (utils/guards.py) into /metrics:
+        # raft_tick_lag histogram + raft_tick_stalls counter.
+        metrics=metrics,
     )
 
     gate = None
@@ -82,10 +91,15 @@ async def serve_async(args) -> None:
 
     tutoring_auth_key = None
     if args.tutoring_auth_key_file:
-        with open(args.tutoring_auth_key_file) as fh:
-            tutoring_auth_key = fh.read().strip()
+        # Off-loop even at startup: this coroutine already shares the loop
+        # with the Raft node being constructed around it, and the habit of
+        # never blocking the loop is what the no-blocking-in-async lint
+        # rule enforces.
+        loop = asyncio.get_running_loop()
+        tutoring_auth_key = (await loop.run_in_executor(
+            None, _read_text, args.tutoring_auth_key_file
+        )).strip()
 
-    metrics = Metrics()
     # Thresholds only; the servicer wires the log/metrics observer itself.
     breaker = CircuitBreaker(
         failure_threshold=args.breaker_threshold,

@@ -242,6 +242,13 @@ def main(argv=None) -> None:
                              "ephemeral); omit to disable")
     parser.add_argument("--no-warmup", action="store_true")
     parser.add_argument(
+        "--strict-dispatch", action="store_true",
+        help="assertion mode for dispatch hygiene (utils/guards.py): any "
+        "device->host readback outside a `with intended_transfer():` "
+        "block raises instead of silently stalling the hot path (TPU/GPU "
+        "backends; CPU readbacks are zero-copy and exempt)",
+    )
+    parser.add_argument(
         "--auth-key-file", default=None,
         help="file holding the LMS↔tutoring shared secret; when set, only "
         "queries HMAC-signed by the LMS leader are answered",
@@ -293,6 +300,13 @@ def main(argv=None) -> None:
 
     if initialize_multihost():
         log.info("joined multi-host JAX cluster")
+
+    if args.strict_dispatch:
+        # Before engine construction so warmup runs under the same guard:
+        # a sync the warmup path tolerates must not hide in the live path.
+        from ..utils.guards import enable_strict_dispatch
+
+        enable_strict_dispatch()
 
     sampling = SamplingParams.reference_defaults(
         max_new_tokens=args.max_new_tokens, approx_top_k=args.approx_topk,

@@ -9,7 +9,11 @@ errored after delivery (response lost), or duplicated.
 
 Targets are plain strings — `"raft:3"` for Raft traffic to peer 3,
 `"tutoring"` for the LMS→tutoring forward, `"*"` as a wildcard fallback —
-so one injector instance can shape an entire node's egress. Specs are
+so one injector instance can shape an entire node's egress. Every sampled
+fault is applied on every target: Raft duplicates re-send through
+`FaultyTransport`, and tutoring duplicates re-send the forward in
+`lms.service.GetLLMAnswer` (it used to be a silent no-op there while
+`injected_total` still counted it). Specs are
 mutable at runtime: the LMS admin endpoint (`POST /admin/faults`) toggles
 them over HTTP, which is how the chaos-over-real-gRPC soak drives a live
 cluster.
@@ -70,10 +74,10 @@ class FaultPlan:
 
 class FaultInjector:
     def __init__(self, seed: int = 0):
-        self._rng = random.Random(seed)
-        self._specs: Dict[str, FaultSpec] = {}
+        self._rng = random.Random(seed)            # guarded-by: _lock
+        self._specs: Dict[str, FaultSpec] = {}     # guarded-by: _lock
         self._lock = threading.Lock()
-        self._injected = 0
+        self._injected = 0                         # guarded-by: _lock
 
     @property
     def active(self) -> bool:

@@ -1,0 +1,216 @@
+"""Runtime guards: the dynamic counterparts of the static lint rules.
+
+`scripts/lint.py` catches dispatch-hygiene and asyncio-discipline bugs that
+are visible in source; this module catches the ones that only exist at
+runtime, with the SAME vocabulary so the two halves reinforce each other:
+
+- `intended_transfer()` marks a sanctioned host<->device sync point. The
+  static rule `no-host-sync-in-dispatch` accepts syncs inside this block,
+  and under strict dispatch the jax transfer guard allows them — one
+  marker serves both checkers.
+- `strict_dispatch()` / `enable_strict_dispatch()` turn on
+  `jax.transfer_guard_device_to_host("disallow")`: any device->host
+  readback OUTSIDE an `intended_transfer()` block raises on backends that
+  move bytes (TPU/GPU; the CPU backend's readbacks are zero-copy and never
+  trip the guard — the static rule is the enforcement there). Exposed as
+  the tutoring server's `--strict-dispatch` flag.
+- `compile_count_guard(...)` generalizes PR 2's compile-count assertion:
+  a context manager over jitted callables that raises `RecompileError`
+  when the guarded region compiled more programs than allowed — the
+  silent-recompile-per-request failure mode (`P()` vs `P(None, None)`)
+  made mechanical.
+- `LoopWatchdog` measures asyncio loop stalls: the Raft tick loop reports
+  its scheduling lag here; lag lands in a Metrics histogram (exported via
+  /metrics as `<name>_lag`) and stalls above the threshold warn and count
+  (`<name>_stalls`). The static rule `no-blocking-in-async` prevents the
+  common causes; the watchdog catches whatever slips through.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Iterator, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+
+class RecompileError(AssertionError):
+    """A guarded region compiled programs it promised not to (the warmup
+    didn't cover a live code path — the PR-2 bug class)."""
+
+
+# --------------------------------------------------------- transfer guards
+
+
+@contextlib.contextmanager
+def intended_transfer() -> Iterator[None]:
+    """Mark a sanctioned host<->device sync point.
+
+    Inside this block, device readbacks are allowed even under strict
+    dispatch. The static rule `no-host-sync-in-dispatch` recognizes the
+    same block lexically, so every sync in a dispatch module is either
+    wrapped here (auditable, greppable) or a lint finding.
+    """
+    import jax
+
+    with jax.transfer_guard_device_to_host("allow"):
+        yield
+
+
+@contextlib.contextmanager
+def strict_dispatch() -> Iterator[None]:
+    """Scoped strict mode: device->host readbacks outside
+    `intended_transfer()` raise (on backends where readbacks are real
+    transfers). Engine test fixtures wrap hot-path runs in this."""
+    import jax
+
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+def enable_strict_dispatch() -> None:
+    """Process-wide strict mode (the `--strict-dispatch` server flag):
+    every unmarked device->host readback from here on raises. Warmup and
+    serving share the setting, so a sync the warmup path tolerates cannot
+    hide in the live path."""
+    import jax
+
+    jax.config.update("jax_transfer_guard_device_to_host", "disallow")
+    log.info("strict dispatch: unmarked device->host transfers will raise")
+
+
+# ------------------------------------------------------ compile-count guard
+
+
+class _CompileCounts:
+    """Snapshot of per-callable jit cache sizes."""
+
+    def __init__(self, fns: Sequence[object]):
+        self.fns = list(fns)
+        self.baseline = [self._size(f) for f in self.fns]
+
+    @staticmethod
+    def _size(fn: object) -> int:
+        size = getattr(fn, "_cache_size", None)
+        if size is None:
+            raise TypeError(
+                f"{fn!r} is not a jitted callable (no _cache_size); pass "
+                "the jax.jit result itself"
+            )
+        return int(size())
+
+    def new_compiles(self) -> int:
+        return sum(
+            self._size(f) - b for f, b in zip(self.fns, self.baseline)
+        )
+
+
+@contextlib.contextmanager
+def compile_count_guard(
+    *fns: object, allow: int = 0, what: str = "guarded region"
+) -> Iterator[_CompileCounts]:
+    """Assert the region compiles at most `allow` new programs across the
+    given jitted callables.
+
+    Generalizes the PR-2 warmup-coverage guard: wrap the live serving path
+    after warmup with `allow=0` and any program the warmup failed to cover
+    — a spelling-different sharding, an unexpected shape — raises
+    `RecompileError` at the moment it happens instead of shipping as a
+    silent tens-of-seconds stall per request.
+
+        with compile_count_guard(eng._step, eng._install) as guard:
+            eng.drain()
+        # guard.new_compiles() also available for reporting
+    """
+    counts = _CompileCounts(fns)
+    yield counts
+    new = counts.new_compiles()
+    if new > allow:
+        raise RecompileError(
+            f"{what} compiled {new} new program(s) (allowed {allow}): "
+            "warmup does not cover a live code path — check for "
+            "spelling-different shardings or unexpected shapes"
+        )
+
+
+# ---------------------------------------------------------- loop watchdog
+
+
+class LoopWatchdog:
+    """Event-loop stall detector for a periodic asyncio task.
+
+    The owner of a loop (the Raft tick loop) calls `observe(lag_s)` with
+    how late each iteration ran versus its schedule; lag lands in a
+    Metrics histogram (`<name>_lag`, seconds — /metrics renders latency
+    percentiles) and stalls above `warn_above_s` increment the
+    `<name>_stalls` counter and log a rate-limited warning. A stalled loop
+    means SOMETHING blocked the thread — sync IO, a device readback, a
+    long pure-Python apply — exactly what `raft/core.py`'s "nothing to
+    lock" single-task design must never experience.
+
+    For loops the caller does not own, `run()` is a standalone heartbeat
+    coroutine: it sleeps `interval_s` and observes its own wake-up lag.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        *,
+        name: str = "loop",
+        warn_above_s: float = 0.25,
+        warn_every_s: float = 10.0,
+        clock=time.monotonic,
+    ):
+        self.metrics = metrics
+        self.name = name
+        self.warn_above_s = warn_above_s
+        self.warn_every_s = warn_every_s
+        self._clock = clock
+        self._last_warn = 0.0
+        self.max_lag_s = 0.0
+        self.stalls = 0
+
+    def observe(self, lag_s: float) -> None:
+        lag_s = max(0.0, float(lag_s))
+        self.max_lag_s = max(self.max_lag_s, lag_s)
+        if self.metrics is not None:
+            self.metrics.hist(f"{self.name}_lag").observe(lag_s)
+        if lag_s <= self.warn_above_s:
+            return
+        self.stalls += 1
+        if self.metrics is not None:
+            self.metrics.inc(f"{self.name}_stalls")
+        now = self._clock()
+        if now - self._last_warn >= self.warn_every_s:
+            self._last_warn = now
+            log.warning(
+                "%s stalled %.0f ms (threshold %.0f ms): something is "
+                "blocking the event loop (%d stalls so far)",
+                self.name, lag_s * 1e3, self.warn_above_s * 1e3, self.stalls,
+            )
+
+    async def run(self, interval_s: float = 0.1) -> None:
+        """Standalone heartbeat for loops the caller can't instrument."""
+        import asyncio
+
+        while True:
+            before = self._clock()
+            await asyncio.sleep(interval_s)
+            self.observe(self._clock() - before - interval_s)
+
+
+def make_tick_watchdog(
+    metrics=None, *, tick_interval: float, name: str = "raft_tick",
+    stall_factor: float = 10.0,
+) -> Optional[LoopWatchdog]:
+    """The Raft wiring: warn when a tick lands `stall_factor` intervals
+    late (a 10 ms tick loop warning at 100 ms of lag — late enough to
+    matter for heartbeats, early enough to catch before elections fire).
+    Returns None without metrics so callers can wire unconditionally."""
+    if metrics is None:
+        return None
+    return LoopWatchdog(
+        metrics, name=name, warn_above_s=tick_interval * stall_factor
+    )

@@ -21,10 +21,10 @@ class LatencyHistogram:
     """Reservoir of recent latencies with percentile queries."""
 
     def __init__(self, max_samples: int = 4096):
-        self._samples: List[float] = []
+        self._samples: List[float] = []  # guarded-by: _lock
         self._max = max_samples
-        self._count = 0
-        self._total = 0.0
+        self._count = 0                  # guarded-by: _lock
+        self._total = 0.0                # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:
@@ -62,9 +62,9 @@ class Metrics:
     """Named counters + histograms + gauges; one per server process."""
 
     def __init__(self):
-        self._counters: Dict[str, int] = {}
-        self._hists: Dict[str, LatencyHistogram] = {}
-        self._gauges: Dict[str, float] = {}
+        self._counters: Dict[str, int] = {}          # guarded-by: _lock
+        self._hists: Dict[str, LatencyHistogram] = {}  # guarded-by: _lock
+        self._gauges: Dict[str, float] = {}          # guarded-by: _lock
         self._lock = threading.Lock()
 
     def set_gauge(self, name: str, value: float) -> None:

@@ -35,6 +35,37 @@ from typing import Callable, List, Optional, Sequence, Tuple
 # hop re-anchors it against its own monotonic clock on receipt.
 DEADLINE_METADATA_KEY = "x-deadline-budget-ms"
 
+# Metadata key carrying the client's idempotency id for ONE logical request,
+# stable across its retries. The wire contract is frozen (QueryRequest has
+# no request_id field), so the id rides gRPC metadata: the LMS uses it to
+# key server-side mutations performed on the client's behalf — specifically
+# the degraded instructor-queue fallback, where a fresh id per retried
+# attempt used to queue duplicate instructor entries (ROADMAP item a).
+REQUEST_ID_METADATA_KEY = "x-request-id"
+
+
+def _metadata_value(metadata, key: str) -> Optional[str]:
+    """First value for `key` in a gRPC metadata sequence (pairs or a
+    mapping — the sync and aio stacks disagree on the shape); None when
+    absent. The single normalization point for every header this module
+    defines."""
+    if metadata is None:
+        return None
+    items = metadata.items() if hasattr(metadata, "items") else metadata
+    for k, v in items:
+        if k == key:
+            return str(v)
+    return None
+
+
+def request_id_from_grpc_context(context) -> Optional[str]:
+    """The client's logical-request id from metadata; None when absent."""
+    try:
+        metadata = context.invocation_metadata()
+    except Exception:
+        return None
+    return _metadata_value(metadata, REQUEST_ID_METADATA_KEY) or None
+
 
 class Overloaded(Exception):
     """Admission refused: a bounded queue is full (maps to
@@ -98,16 +129,13 @@ class Deadline:
     ) -> Optional["Deadline"]:
         """Decode the budget header from a gRPC metadata sequence (pairs or
         a mapping); None when absent or malformed."""
-        if metadata is None:
+        value = _metadata_value(metadata, DEADLINE_METADATA_KEY)
+        if value is None:
             return None
-        items = metadata.items() if hasattr(metadata, "items") else metadata
-        for key, value in items:
-            if key == DEADLINE_METADATA_KEY:
-                try:
-                    return cls.after(int(value) / 1000.0, clock=clock)
-                except (TypeError, ValueError):
-                    return None
-        return None
+        try:
+            return cls.after(int(value) / 1000.0, clock=clock)
+        except (TypeError, ValueError):
+            return None
 
     @classmethod
     def from_grpc_context(
@@ -194,16 +222,17 @@ class CircuitBreaker:
         self._clock = clock
         self._on_state_change = on_state_change
         self._lock = threading.Lock()
-        self._state = self.CLOSED
-        self._consecutive_failures = 0
-        self._opened_at = 0.0
-        self._half_open_inflight = 0
-        self._half_open_since = 0.0
+        self._state = self.CLOSED        # guarded-by: _lock
+        self._consecutive_failures = 0   # guarded-by: _lock
+        self._opened_at = 0.0            # guarded-by: _lock
+        self._half_open_inflight = 0     # guarded-by: _lock
+        self._half_open_since = 0.0      # guarded-by: _lock
+        # guarded-by: _lock
         self._stats = {"opened": 0, "rejected": 0, "failures": 0, "successes": 0}
 
     # ------------------------------------------------------------- internals
 
-    def _transition(self, new_state: str) -> None:
+    def _transition(self, new_state: str) -> None:  # guarded-by: _lock
         old, self._state = self._state, new_state
         if new_state is self.OPEN:
             self._opened_at = self._clock()
@@ -234,7 +263,7 @@ class CircuitBreaker:
             self._maybe_half_open()
             return self._state
 
-    def _maybe_half_open(self) -> None:
+    def _maybe_half_open(self) -> None:  # guarded-by: _lock
         if (
             self._state is self.OPEN
             and self._clock() - self._opened_at >= self.recovery_s

@@ -1,86 +1,29 @@
 #!/usr/bin/env python
 """Tier-1 marker audit: long-running tests must be marked `slow`.
 
-Tier-1 runs `pytest -m 'not slow'` under a hard timeout; one unmarked soak
-blows the whole budget. This audit makes the convention mechanical instead
-of tribal: any test function whose name advertises a long-running shape
-(`soak`, `sustained`, `stress_many`) must carry `@pytest.mark.slow` —
-either directly, on its class, or via a module-level `pytestmark`.
-
-Run standalone (`python scripts/audit_markers.py`) for CI, or through
-`tests/test_marker_audit.py` so the audit itself rides tier-1.
+Thin shim kept so existing invocations (`python scripts/audit_markers.py`,
+`tests/test_marker_audit.py`) keep working — the check itself now lives in
+the lint framework as the `slow-marker` rule
+(`distributed_lms_raft_llm_tpu/analysis/rules/slow_marker.py`) and also
+runs as part of `python scripts/lint.py`.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import List
 
-# Name fragments that mean "this test is a soak, not a unit test".
-SLOW_NAME_HINTS = ("soak", "sustained", "stress_many")
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
-
-def _is_slow_mark(node: ast.expr) -> bool:
-    """True for `pytest.mark.slow` / `mark.slow` (bare or called)."""
-    if isinstance(node, ast.Call):
-        node = node.func
-    return isinstance(node, ast.Attribute) and node.attr == "slow"
-
-
-def _module_marked_slow(tree: ast.Module) -> bool:
-    for stmt in tree.body:
-        if isinstance(stmt, ast.Assign):
-            targets = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
-            if "pytestmark" in targets:
-                values = (
-                    stmt.value.elts
-                    if isinstance(stmt.value, (ast.List, ast.Tuple))
-                    else [stmt.value]
-                )
-                if any(_is_slow_mark(v) for v in values):
-                    return True
-    return False
-
-
-def audit(tests_dir: Path) -> List[str]:
-    """Paths of soak-shaped tests missing the slow marker."""
-    violations: List[str] = []
-    for path in sorted(tests_dir.glob("test_*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        module_slow = _module_marked_slow(tree)
-
-        def visit(body, class_slow: bool) -> None:
-            for node in body:
-                if isinstance(node, ast.ClassDef):
-                    cls_slow = class_slow or any(
-                        _is_slow_mark(d) for d in node.decorator_list
-                    )
-                    visit(node.body, cls_slow)
-                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    if not node.name.startswith("test_"):
-                        continue
-                    if not any(h in node.name for h in SLOW_NAME_HINTS):
-                        continue
-                    fn_slow = any(
-                        _is_slow_mark(d) for d in node.decorator_list
-                    )
-                    if not (fn_slow or class_slow or module_slow):
-                        violations.append(
-                            f"{path.name}::{node.name} looks like a soak "
-                            "(name hints: "
-                            f"{[h for h in SLOW_NAME_HINTS if h in node.name]}) "
-                            "but lacks @pytest.mark.slow"
-                        )
-
-        visit(tree.body, class_slow=False)
-    return violations
+from distributed_lms_raft_llm_tpu.analysis.rules.slow_marker import (  # noqa: E402,F401
+    SLOW_NAME_HINTS,
+    audit,
+)
 
 
 def main() -> int:
-    tests_dir = Path(__file__).resolve().parent.parent / "tests"
-    violations = audit(tests_dir)
+    violations = audit(REPO / "tests")
     for v in violations:
         print(f"MARKER AUDIT: {v}", file=sys.stderr)
     if violations:

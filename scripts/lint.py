@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""dlrl-lint CLI: run the repo-native static-analysis suite.
+
+    python scripts/lint.py                 # whole tree (package+scripts+tests)
+    python scripts/lint.py --json          # machine-readable findings
+    python scripts/lint.py --rule guarded-by engine/  # one rule, one subtree
+    python scripts/lint.py --list-rules    # the catalog
+
+Exit status: 0 when clean, 1 when any unsuppressed finding remains, 2 on
+usage errors. `tests/test_lint_clean.py` runs the same `run_lint()` entry
+point in tier-1, so CI and this CLI can never disagree about "clean".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from distributed_lms_raft_llm_tpu.analysis import (  # noqa: E402
+    all_rules,
+    run_lint,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: the "
+                             "package, scripts/ and tests/)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON document")
+    parser.add_argument("--rule", action="append", default=None,
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for rule in sorted(rules, key=lambda r: r.name):
+            print(f"{rule.name}: {rule.description}")
+        return 0
+    if args.rule:
+        known = {r.name for r in rules}
+        unknown = set(args.rule) - known
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)} "
+                  f"(known: {sorted(known)})", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in set(args.rule)]
+
+    paths = [Path(p) for p in args.paths] or None
+    findings = run_lint(paths=paths, rules=rules, root=REPO)
+
+    if args.as_json:
+        print(json.dumps({
+            "clean": not findings,
+            "rules": sorted(r.name for r in rules),
+            "findings": [f.to_json() for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format(), file=sys.stderr)
+        if findings:
+            print(f"\n{len(findings)} finding(s) across "
+                  f"{len({f.path for f in findings})} file(s); suppress "
+                  "intentional cases with `# lint: disable=<rule>` "
+                  "(see README)", file=sys.stderr)
+        else:
+            print(f"lint ok ({len(rules)} rules)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,3 +44,15 @@ def eight_devices():
     return devices[:8]
 
 
+@pytest.fixture
+def strict_dispatch_guard():
+    """Engine tests opt in to dispatch-hygiene assertion mode: any
+    device->host readback outside `with intended_transfer():` raises on
+    backends where readbacks are real transfers (utils/guards.py; the
+    static rule no-host-sync-in-dispatch is the CPU-side enforcement)."""
+    from distributed_lms_raft_llm_tpu.utils.guards import strict_dispatch
+
+    with strict_dispatch():
+        yield
+
+

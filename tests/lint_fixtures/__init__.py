@@ -1,0 +1,7 @@
+# Known-good and known-bad snippets for tests/test_lint_rules.py.
+#
+# These files are PARSED by the lint framework, never imported — undefined
+# names are fine. Lines expected to be flagged carry an `# EXPECT: <rule>`
+# marker; everything else must stay clean. The directory is excluded from
+# full lint runs (analysis.core.EXCLUDE_PARTS) and from pytest collection
+# (no test_ prefix on the snippet files).

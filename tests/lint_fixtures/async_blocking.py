@@ -1,0 +1,43 @@
+"""no-blocking-in-async fixtures."""
+
+import asyncio
+import subprocess
+import time
+
+import jax
+import numpy as np
+
+
+async def bad_handler(request, fut, arr):
+    time.sleep(0.5)  # EXPECT: no-blocking-in-async
+    fh = open("state.json")  # EXPECT: no-blocking-in-async
+    data = fut.result()  # EXPECT: no-blocking-in-async
+    out = subprocess.run(["ls"])  # EXPECT: no-blocking-in-async
+    host = jax.device_get(arr)  # EXPECT: no-blocking-in-async
+    buf = np.asarray(arr)  # EXPECT: no-blocking-in-async
+    n = arr.item()  # EXPECT: no-blocking-in-async
+    return fh, data, out, host, buf, n
+
+
+async def good_handler(request, arr, engine, loop):
+    await asyncio.sleep(0.5)
+    content = await loop.run_in_executor(None, engine.answer_batch, ["q"])
+
+    def read_blob():  # sync helper destined for the executor: exempt
+        with open("blob.bin", "rb") as fh:
+            return fh.read()
+
+    blob = await loop.run_in_executor(None, read_blob)
+    return content, blob
+
+
+def sync_code_is_out_of_scope(path):
+    time.sleep(0.1)          # blocking is fine off the event loop
+    with open(path) as fh:
+        return fh.read()
+
+
+async def suppressed_handler(path):
+    # Startup-only read on an otherwise idle loop.
+    with open(path) as fh:  # lint: disable=no-blocking-in-async
+        return fh.read()

@@ -1,0 +1,77 @@
+"""guarded-by fixtures."""
+
+import threading
+
+
+class GoodCounter:
+    def __init__(self):
+        self._counts = {}   # guarded-by: _lock
+        self._total = 0     # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def inc(self, name):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + 1
+            self._total += 1
+
+    def _drain(self):  # guarded-by: _lock
+        self._counts.clear()
+        self._total = 0
+
+    def reset(self):
+        with self._lock:
+            self._drain()
+
+    def read(self, name):
+        with self._lock:
+            return self._counts.get(name, 0)  # reads aren't checked
+
+
+class BadCounter:
+    def __init__(self):
+        self._counts = {}   # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def inc_unlocked(self, name):
+        self._counts[name] = 1  # EXPECT: guarded-by
+
+    def clear_unlocked(self):
+        self._counts.clear()  # EXPECT: guarded-by
+
+    def del_unlocked(self, name):
+        del self._counts[name]  # EXPECT: guarded-by
+
+    def _locked_helper(self):  # guarded-by: _lock
+        self._counts.clear()
+
+    def calls_locked_helper_without_lock(self):
+        self._locked_helper()  # EXPECT: guarded-by
+
+
+class LoopConfined:
+    def __init__(self, loop):
+        self._loop = loop
+        self._futures = {}  # guarded-by: event-loop
+
+    def on_loop(self, rid, fut):
+        self._futures[rid] = fut         # fine: loop context
+
+    def escapes(self, executor):
+        def mutate():
+            self._futures.clear()  # EXPECT: guarded-by
+
+        executor.submit(mutate)
+
+    def escapes_via_run_in_executor(self):
+        self._loop.run_in_executor(
+            None, lambda: self._futures.pop(1)  # EXPECT: guarded-by
+        )
+
+
+class Suppressed:
+    def __init__(self):
+        self._state = 0  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def single_writer_path(self):
+        self._state = 1  # lint: disable=guarded-by

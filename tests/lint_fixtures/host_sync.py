@@ -1,0 +1,39 @@
+"""no-host-sync-in-dispatch fixtures: unmarked readbacks are flagged,
+syncs inside `with intended_transfer():` are not."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_lms_raft_llm_tpu.utils.guards import intended_transfer
+
+
+def hot_loop(state, toks_dev):
+    # ------------------------------------------------------------- bad
+    toks = np.asarray(toks_dev)  # EXPECT: no-host-sync-in-dispatch
+    first = jax.device_get(state.tok)  # EXPECT: no-host-sync-in-dispatch
+    n = state.length.item()  # EXPECT: no-host-sync-in-dispatch
+    xs = state.tok.tolist()  # EXPECT: no-host-sync-in-dispatch
+    total = float(jnp.sum(state.seen))  # EXPECT: no-host-sync-in-dispatch
+    state.tok.block_until_ready()  # EXPECT: no-host-sync-in-dispatch
+    return toks, first, n, xs, total
+
+
+def sanctioned(state, toks_dev):
+    # ------------------------------------------------------------ good
+    with intended_transfer():
+        toks = np.asarray(toks_dev)
+        first = jax.device_get(state.tok)
+    host_batch = np.zeros((4, 4))
+    host_list = host_batch.shape[0]          # host-side numpy is fine
+    ids = jnp.asarray(host_batch)            # h2d staging is not a sync
+    try:
+        toks_dev.copy_to_host_async()        # async copy: not a sync point
+    except AttributeError:
+        pass
+    x = float(host_list)                     # cast of a host value
+    return toks, first, ids, x
+
+
+def suppressed(val_dev):
+    return val_dev.item()  # lint: disable=no-host-sync-in-dispatch

@@ -1,0 +1,32 @@
+"""slow-marker fixtures (filename intentionally not test_-prefixed so
+pytest never collects these; the rule is exercised directly on the Source).
+"""
+
+import pytest
+
+
+def test_soak_unmarked():  # EXPECT: slow-marker
+    pass
+
+
+def test_sustained_load_unmarked():  # EXPECT: slow-marker
+    pass
+
+
+def test_fast_unit():
+    pass
+
+
+@pytest.mark.slow
+def test_soak_marked():
+    pass
+
+
+@pytest.mark.slow
+class TestSlowGroup:
+    def test_stress_many_in_marked_class(self):
+        pass
+
+
+def test_soak_suppressed():  # lint: disable=slow-marker
+    pass

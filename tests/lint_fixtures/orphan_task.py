@@ -1,0 +1,46 @@
+"""no-orphan-task fixtures."""
+
+import asyncio
+
+
+async def worker():
+    await asyncio.sleep(0)
+
+
+async def deliver(peer, message):
+    await asyncio.sleep(0)
+
+
+class Runner:
+    async def _run(self):
+        await asyncio.sleep(0)
+
+    def bad_spawns(self, loop, old_channel):
+        asyncio.ensure_future(old_channel.close())  # EXPECT: no-orphan-task
+        asyncio.create_task(worker())  # EXPECT: no-orphan-task
+        loop.create_task(worker())  # EXPECT: no-orphan-task
+
+    def bad_unawaited(self):
+        worker()  # EXPECT: no-orphan-task
+        self._run()  # EXPECT: no-orphan-task
+
+    def good_spawns(self, loop):
+        task = asyncio.ensure_future(worker())
+        self._tasks = [task]
+        task.add_done_callback(self._tasks.remove)
+        kept = loop.create_task(worker())
+        return kept
+
+    async def good_awaits(self):
+        await worker()
+        await self._run()
+        result = worker()          # handle kept: caller's responsibility
+        return await result
+
+    def good_out_of_scope(self):
+        # Receiver types are unknown to a lexical pass: not flagged.
+        asyncio.run(worker())
+        self.queue.close()
+
+    def suppressed(self):
+        asyncio.ensure_future(worker())  # lint: disable=no-orphan-task

@@ -1,0 +1,26 @@
+"""canonical-pspec fixtures: trailing literal Nones are flagged, canonical
+spellings and computed specs are not."""
+
+import jax.sharding
+from jax.sharding import PartitionSpec as P
+
+# ------------------------------------------------------------------ bad
+
+BAD_REPLICATED = P(None, None)  # EXPECT: canonical-pspec
+BAD_TRAILING = P("tp", None)  # EXPECT: canonical-pspec
+BAD_ROW_PARALLEL = P(None, "tp", None)  # EXPECT: canonical-pspec
+BAD_LONG_FORM = jax.sharding.PartitionSpec(None)  # EXPECT: canonical-pspec
+BAD_TRIPLE = P(None, None, None)  # EXPECT: canonical-pspec
+
+# ----------------------------------------------------------------- good
+
+GOOD_EMPTY = P()
+GOOD_LEADING_NONE = P(None, "tp")       # leading None is meaningful
+GOOD_INTERIOR_NONE = P(None, None, "tp")
+GOOD_AXIS_ONLY = P("tp")
+GOOD_COMPUTED = P(*([None] * 3))        # canonicalizers build these
+GOOD_VARIABLE_TAIL = P("dp", some_axis_name)
+
+# ------------------------------------------------------------ suppressed
+
+SHARD_MAP_SPEC = P("dp", "tp", "sp", None)  # lint: disable=canonical-pspec

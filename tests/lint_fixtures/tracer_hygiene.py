@@ -1,0 +1,58 @@
+"""tracer-hygiene fixtures."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def bad_program(params, x, flags):
+    total = jnp.sum(x)
+    if total > 0:  # EXPECT: tracer-hygiene
+        x = x + 1
+    while jnp.any(x > 0):  # EXPECT: tracer-hygiene
+        x = x - 1
+    mask = jax.lax.select(x > 0, x, -x)
+    y = mask * 2
+    assert x.shape  # param shapes are static: no traced name in the test
+    probe = bool(jnp.all(y > 0))  # EXPECT: tracer-hygiene
+    sign = 1 if jnp.sum(y) > 0 else -1  # EXPECT: tracer-hygiene
+    return y, probe, sign
+
+
+def good_program(params, x, cfg, cache):
+    if cfg.quantized:          # python config attribute: static under trace
+        x = x * cfg.scale
+    if cache is None:          # None-checks of params are static
+        cache = jnp.zeros_like(x)
+    n = x.shape[0]
+    if n > 4:                  # shapes are python ints
+        x = x[:4]
+    y = jnp.where(x > 0, x, 0)  # the traced-friendly spelling
+    return jax.lax.cond(True, lambda v: v, lambda v: -v, y)
+
+
+def not_jitted(x):
+    # Plain host code: control flow on jnp results is legal (eager).
+    if jnp.sum(x) > 0:
+        return x
+    return -x
+
+
+def suppressed_program(params, x):
+    s = jnp.sum(x)
+    if s > 0:  # lint: disable=tracer-hygiene
+        return x
+    return -x
+
+
+_bad = jax.jit(partial(bad_program, flags=()))
+_good = jax.jit(good_program)
+_suppressed = jax.jit(suppressed_program)
+
+_grow = jax.jit(grow_program, static_argnums=(1,))
+
+
+def dispatches(state):
+    _grow(state, (4, 8))                       # tuple: hashable, fine
+    _grow(state, [4, 8])  # EXPECT: tracer-hygiene

@@ -34,7 +34,9 @@ def test_engine_mesh_uses_all_devices(engine):
     assert engine.mesh.devices.size == 8  # 2-way tp × 4-way dp
 
 
-def test_answer_batch_shapes_and_determinism(engine):
+def test_answer_batch_shapes_and_determinism(engine, strict_dispatch_guard):
+    # Runs under dispatch-hygiene assertion mode (conftest fixture): every
+    # host sync on the serving path must be a marked intended_transfer().
     answers = engine.answer_batch(["hello world", "what is raft?"])
     assert len(answers) == 2
     assert all(isinstance(a, str) for a in answers)

@@ -1,0 +1,188 @@
+"""Runtime guards (utils/guards.py): the dynamic counterparts of the lint
+rules — compile-count guard, strict-dispatch transfer guard wiring, and the
+asyncio loop-stall watchdog on the Raft tick loop.
+"""
+
+import asyncio
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_lms_raft_llm_tpu.utils.guards import (
+    LoopWatchdog,
+    RecompileError,
+    compile_count_guard,
+    intended_transfer,
+    make_tick_watchdog,
+    strict_dispatch,
+)
+from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+
+# ------------------------------------------------------ compile-count guard
+
+
+def test_compile_count_guard_passes_when_warm():
+    fn = jax.jit(lambda x: x * 2)
+    fn(jnp.ones((4,)))  # warm
+    with compile_count_guard(fn) as guard:
+        fn(jnp.ones((4,)))
+        fn(jnp.zeros((4,)))  # same shape: cached program
+    assert guard.new_compiles() == 0
+
+
+def test_compile_count_guard_catches_recompiles():
+    fn = jax.jit(lambda x: x * 2)
+    fn(jnp.ones((4,)))
+    with pytest.raises(RecompileError, match="1 new program"):
+        with compile_count_guard(fn, what="shape change"):
+            fn(jnp.ones((8,)))  # new shape: new program
+
+
+def test_compile_count_guard_allowance_and_multiple_fns():
+    f = jax.jit(lambda x: x + 1)
+    g = jax.jit(lambda x: x - 1)
+    with compile_count_guard(f, g, allow=2):
+        f(jnp.ones((2,)))
+        g(jnp.ones((2,)))
+
+
+def test_compile_count_guard_rejects_unjitted():
+    with pytest.raises(TypeError, match="not a jitted callable"):
+        with compile_count_guard(lambda x: x):
+            pass
+
+
+# -------------------------------------------------------- transfer guards
+
+
+def test_strict_dispatch_sets_and_restores_transfer_guard():
+    """The scoped guard installs jax's device->host disallow mode and
+    restores the previous mode on exit. (The CPU backend's readbacks are
+    zero-copy and never trip the guard, so enforcement is exercised on
+    real accelerators; here we pin the wiring.)"""
+    before = jax.config.jax_transfer_guard_device_to_host
+    with strict_dispatch():
+        assert (jax.config.jax_transfer_guard_device_to_host == "disallow")
+        # Marked sync points re-allow inside the strict scope.
+        with intended_transfer():
+            assert (jax.config.jax_transfer_guard_device_to_host == "allow")
+            import numpy as np
+
+            np.asarray(jnp.arange(3))  # sanctioned readback
+        assert (jax.config.jax_transfer_guard_device_to_host == "disallow")
+    assert jax.config.jax_transfer_guard_device_to_host == before
+
+
+def test_engine_hot_path_runs_under_strict_dispatch():
+    """The paged engine's submit->step->reap loop completes under strict
+    dispatch: every host sync on the path is wrapped in
+    intended_transfer() (the same marker the lint rule checks)."""
+    from distributed_lms_raft_llm_tpu.engine import EngineConfig, PagedEngine
+    from distributed_lms_raft_llm_tpu.engine.sampling import SamplingParams
+
+    eng = PagedEngine(
+        EngineConfig(
+            model="tiny",
+            sampling=SamplingParams(max_new_tokens=4),
+            length_buckets=(8,),
+            batch_buckets=(1, 2),
+            dtype=jnp.float32,
+        ),
+        slots=2,
+        chunk=2,
+    )
+    with strict_dispatch():
+        rid = eng.submit("a question")
+        out = eng.drain()
+    assert isinstance(out[rid], str)
+
+
+# ---------------------------------------------------------- loop watchdog
+
+
+def test_watchdog_records_lag_and_counts_stalls():
+    clock = [0.0]
+    metrics = Metrics()
+    wd = LoopWatchdog(metrics, name="tick", warn_above_s=0.1,
+                      clock=lambda: clock[0])
+    wd.observe(0.01)   # healthy
+    wd.observe(0.5)    # stall
+    clock[0] += 100.0  # past the warn rate limit
+    wd.observe(0.9)    # stall
+    snap = metrics.snapshot()
+    assert snap["latency"]["tick_lag"]["count"] == 3
+    assert snap["counters"]["tick_stalls"] == 2
+    assert wd.max_lag_s == pytest.approx(0.9)
+    assert wd.stalls == 2
+
+
+def test_watchdog_negative_lag_clamped():
+    wd = LoopWatchdog(None, name="t", warn_above_s=1.0)
+    wd.observe(-0.5)
+    assert wd.max_lag_s == 0.0
+    assert wd.stalls == 0
+
+
+def test_make_tick_watchdog_thresholds():
+    metrics = Metrics()
+    wd = make_tick_watchdog(metrics, tick_interval=0.01)
+    assert wd is not None
+    assert wd.warn_above_s == pytest.approx(0.1)
+    assert make_tick_watchdog(None, tick_interval=0.01) is None
+
+
+def test_raft_tick_loop_feeds_the_watchdog():
+    """RaftNode wiring: a blocking apply callback on the loop shows up as
+    tick lag in /metrics (raft_tick_lag histogram + raft_tick_stalls)."""
+    from distributed_lms_raft_llm_tpu.raft.node import MemNetwork, RaftNode
+    from distributed_lms_raft_llm_tpu.raft.storage import MemoryStorage
+
+    async def run():
+        metrics = Metrics()
+        net = MemNetwork()
+        node = RaftNode(
+            1, {1: ""}, MemoryStorage(), net.transport_for(1),
+            tick_interval=0.005,
+            watchdog=LoopWatchdog(metrics, name="raft_tick",
+                                  warn_above_s=0.05),
+        )
+        net.register(node)
+        await node.start()
+        try:
+            # Give the single-node cluster time to elect itself and tick.
+            await asyncio.sleep(0.1)
+            # Stall the LOOP (not the node): exactly what the watchdog is
+            # for — a blocking call anywhere on the shared loop (and
+            # exactly what the lint rule flags; here the block is the
+            # point).  # lint: disable-next=no-blocking-in-async
+            time.sleep(0.12)
+            await asyncio.sleep(0.05)
+        finally:
+            await node.stop()
+        return metrics.snapshot()
+
+    snap = asyncio.run(run())
+    assert snap["latency"]["raft_tick_lag"]["count"] > 0
+    assert snap["latency"]["raft_tick_lag"]["max_s"] >= 0.1
+    assert snap["counters"]["raft_tick_stalls"] >= 1
+
+
+def test_lms_node_wires_watchdog_into_metrics(tmp_path):
+    """LMSNode(metrics=...) hands the tick watchdog to its RaftNode; the
+    lag series lands in the same Metrics object /metrics serves."""
+    from distributed_lms_raft_llm_tpu.lms.node import LMSNode
+    from distributed_lms_raft_llm_tpu.raft.node import MemNetwork
+
+    metrics = Metrics()
+    net = MemNetwork()
+    node = LMSNode(1, {1: ""}, str(tmp_path / "n1"),
+                   transport=net.transport_for(1), metrics=metrics)
+    assert node.node.watchdog is not None
+    assert node.node.watchdog.metrics is metrics
+    # Without metrics the wiring degrades to no watchdog, not a crash.
+    node2 = LMSNode(2, {2: ""}, str(tmp_path / "n2"),
+                    transport=net.transport_for(2))
+    assert node2.node.watchdog is None

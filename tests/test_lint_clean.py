@@ -1,0 +1,38 @@
+"""Tier-1 gate: the tree is lint-clean under the full rule set.
+
+The `tests/test_marker_audit.py` pattern generalized: every rule in the
+catalog runs over the package, scripts, and tests, and any unsuppressed
+finding fails the suite — so the bug classes the rules encode (the PR-2
+silent-recompile spelling bug above all) cannot be reintroduced without a
+visible, attributable `# lint: disable=` comment in the diff.
+"""
+
+from distributed_lms_raft_llm_tpu.analysis import all_rules, run_lint
+
+
+def test_tree_is_lint_clean():
+    rules = all_rules()
+    assert len(rules) >= 6, "the catalog must keep at least six active rules"
+    findings = run_lint(rules=rules)
+    assert not findings, (
+        f"{len(findings)} unsuppressed lint finding(s):\n"
+        + "\n".join(f.format() for f in findings)
+        + "\n\nFix the code, or suppress an intentional case with "
+        "`# lint: disable=<rule>` and a reason (see README: dlrl-lint)."
+    )
+
+
+def test_rule_set_covers_the_demonstrated_bug_classes():
+    """The PR acceptance list: each demonstrated bug class has a live rule.
+    Removing or renaming one must be a conscious, reviewed act."""
+    names = {r.name for r in all_rules()}
+    for required in (
+        "canonical-pspec",           # PR-2: P() vs P(None, None) recompiles
+        "no-host-sync-in-dispatch",  # paged-engine readback stalls
+        "no-blocking-in-async",      # raft/serving loop stalls
+        "no-orphan-task",            # dropped task handles (grpc_transport)
+        "guarded-by",                # lock-guarded state (PR-1 review class)
+        "tracer-hygiene",            # python control flow on tracers
+        "slow-marker",               # tier-1 timeout protection
+    ):
+        assert required in names, f"rule {required} missing from the catalog"

@@ -1,0 +1,194 @@
+"""Per-rule lint tests over the fixture corpus.
+
+Each fixture file under `tests/lint_fixtures/` carries `# EXPECT: <rule>`
+markers on exactly the lines a rule must flag; everything else (the
+known-good and the `# lint: disable=` suppressed examples) must stay
+silent. The harness compares flagged-line sets to expected-line sets, so
+each rule's hits, misses, AND suppression handling are pinned in one
+assertion per fixture.
+
+Rules are exercised via `rule.check(Source)` directly — path scoping
+(`applies_to`) is tested separately, so the host-sync and slow-marker
+fixtures don't need to masquerade as engine files or collectible tests.
+"""
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+from distributed_lms_raft_llm_tpu.analysis import all_rules
+from distributed_lms_raft_llm_tpu.analysis.core import Source
+from distributed_lms_raft_llm_tpu.analysis.rules.async_blocking import (
+    BlockingInAsyncRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.canonical_pspec import (
+    CanonicalPSpecRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.guarded_by import (
+    GuardedByRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.host_sync import (
+    HostSyncInDispatchRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.orphan_task import (
+    OrphanTaskRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.slow_marker import (
+    SlowMarkerRule,
+    audit,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.tracer_hygiene import (
+    TracerHygieneRule,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "lint_fixtures"
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Za-z0-9_,\- ]+)")
+
+
+def expected_lines(src: Source, rule_name: str):
+    out = set()
+    for lineno, line in enumerate(src.lines, start=1):
+        m = _EXPECT_RE.search(line)
+        if m and rule_name in {n.strip() for n in m.group(1).split(",")}:
+            out.add(lineno)
+    return out
+
+
+def run_rule(rule, fixture: str):
+    src = Source(FIXTURES / fixture, root=REPO)
+    flagged = {
+        f.line for f in rule.check(src) if not src.suppressed(f.rule, f.line)
+    }
+    expected = expected_lines(src, rule.name)
+    assert flagged == expected, (
+        f"{rule.name} on {fixture}: flagged {sorted(flagged)} but expected "
+        f"{sorted(expected)} (false positives: "
+        f"{sorted(flagged - expected)}, misses: {sorted(expected - flagged)})"
+    )
+    return src
+
+
+def test_canonical_pspec_fixture():
+    run_rule(CanonicalPSpecRule(), "pspec.py")
+
+
+def test_host_sync_fixture():
+    run_rule(HostSyncInDispatchRule(), "host_sync.py")
+
+
+def test_async_blocking_fixture():
+    run_rule(BlockingInAsyncRule(), "async_blocking.py")
+
+
+def test_orphan_task_fixture():
+    run_rule(OrphanTaskRule(), "orphan_task.py")
+
+
+def test_guarded_by_fixture():
+    run_rule(GuardedByRule(), "guarded_by.py")
+
+
+def test_tracer_hygiene_fixture():
+    run_rule(TracerHygieneRule(), "tracer_hygiene.py")
+
+
+def test_slow_marker_fixture():
+    run_rule(SlowMarkerRule(), "markers.py")
+
+
+# ------------------------------------------------------------- framework
+
+
+def test_rule_registry_has_the_catalog():
+    names = {r.name for r in all_rules()}
+    assert {
+        "canonical-pspec",
+        "no-host-sync-in-dispatch",
+        "no-blocking-in-async",
+        "no-orphan-task",
+        "guarded-by",
+        "tracer-hygiene",
+        "slow-marker",
+    } <= names
+    assert len(names) >= 6
+    for rule in all_rules():
+        assert rule.description, f"{rule.name} needs a description"
+
+
+def test_suppression_forms(tmp_path):
+    """Same-line, next-line, and file-level suppressions all work, and an
+    unrelated rule name does not suppress."""
+    code = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "A = P(None, None)  # lint: disable=canonical-pspec\n"
+        "# lint: disable-next=canonical-pspec\n"
+        "B = P(None, None)\n"
+        "C = P(None, None)  # lint: disable=some-other-rule\n"
+        "D = P(None, None)\n"
+    )
+    path = tmp_path / "snippet.py"
+    path.write_text(code)
+    src = Source(path, root=tmp_path)
+    rule = CanonicalPSpecRule()
+    live = {
+        f.line for f in rule.check(src) if not src.suppressed(f.rule, f.line)
+    }
+    assert live == {5, 6}
+
+    path.write_text("# lint: disable-file=canonical-pspec\n" + code)
+    src = Source(path, root=tmp_path)
+    live = {
+        f.line for f in rule.check(src) if not src.suppressed(f.rule, f.line)
+    }
+    assert live == set()
+
+
+def test_path_scoping():
+    """applies_to: host-sync only watches the engine dispatch modules;
+    slow-marker only watches test files."""
+    host = HostSyncInDispatchRule()
+    assert host.applies_to("distributed_lms_raft_llm_tpu/engine/paged.py")
+    assert host.applies_to("distributed_lms_raft_llm_tpu/engine/engine.py")
+    assert not host.applies_to("distributed_lms_raft_llm_tpu/lms/service.py")
+    marker = SlowMarkerRule()
+    assert marker.applies_to("tests/test_engine.py")
+    assert not marker.applies_to("tests/conftest.py")
+    assert not marker.applies_to("distributed_lms_raft_llm_tpu/config.py")
+
+
+def test_audit_markers_shim_still_works():
+    """The folded-in rule keeps the audit() API the old script exposed;
+    the real tests tree must be clean through it."""
+    assert audit(REPO / "tests") == []
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    """`scripts/lint.py` is the same runner: clean tree -> exit 0 and
+    clean JSON; a bad file -> exit 1 with the finding listed."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint.py"), "--json"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert '"clean": true' in out.stdout
+
+    listing = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint.py"), "--list-rules"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert listing.returncode == 0
+    assert "canonical-pspec" in listing.stdout
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from jax.sharding import PartitionSpec as P\nA = P(None, None)\n"
+    )
+    failing = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint.py"), str(bad)],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert failing.returncode == 1
+    assert "canonical-pspec" in failing.stderr

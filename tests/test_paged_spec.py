@@ -33,6 +33,7 @@ from distributed_lms_raft_llm_tpu.engine.paged import (
 )
 from distributed_lms_raft_llm_tpu.engine.sampling import seen_mask_from_ids
 from distributed_lms_raft_llm_tpu.models import registry
+from distributed_lms_raft_llm_tpu.utils.guards import compile_count_guard
 from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
 
 MAX_NEW = 8
@@ -224,17 +225,18 @@ def test_step_program_compiles_once_per_width():
     assert len(eng.widths) == 2
     eng.warmup()
     programs = (eng._step, eng._install, eng._prefill, eng._grow)
-    warm = [p._cache_size() for p in programs]
-    assert warm[0] == len(eng.widths)
+    assert programs[0]._cache_size() == len(eng.widths)
     short, lng = "k v", "a long question about raft elections and logs"
-    eng.submit(short)
-    eng.step()       # running at the narrow width
-    eng.submit(lng)  # grows the live cache mid-batch
-    eng.drain()
-    for prompt in (short, lng, short):  # idle rebuilds at both widths
-        eng.submit(prompt)
-    eng.drain()
-    assert [p._cache_size() for p in programs] == warm
+    # The reusable runtime guard (utils/guards.py) generalizes this
+    # assertion: zero new programs across the whole live session.
+    with compile_count_guard(*programs, what="live paged session"):
+        eng.submit(short)
+        eng.step()       # running at the narrow width
+        eng.submit(lng)  # grows the live cache mid-batch
+        eng.drain()
+        for prompt in (short, lng, short):  # idle rebuilds at both widths
+            eng.submit(prompt)
+        eng.drain()
 
 
 def test_dead_slot_emits_no_filler_when_pad_differs_from_eos():

@@ -34,6 +34,7 @@ from distributed_lms_raft_llm_tpu.utils.faults import FaultInjector
 from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
 from distributed_lms_raft_llm_tpu.utils.resilience import (
     DEADLINE_METADATA_KEY,
+    REQUEST_ID_METADATA_KEY,
     CircuitBreaker,
 )
 
@@ -279,6 +280,82 @@ def test_tutoring_overload_returns_resource_exhausted(stack):
             await asyncio.gather(*futs, return_exceptions=True)
 
         asyncio.run_coroutine_threadsafe(drain(), loop).result(30)
+
+
+def test_degraded_fallback_dedupes_client_retries(stack, student):
+    """ROADMAP item (a): ONE logical ask_llm, retried, queues ONE
+    instructor entry. The client threads a single x-request-id across its
+    retries; the degraded fallback keys the replicated AskQuery on it, so
+    the applier's idempotency ledger drops the retry's duplicate."""
+    stack["injector"].configure("tutoring", drop=1.0)
+    query = "idempotent degraded question (one entry expected)"
+    try:
+        with grpc.insecure_channel(stack["address"]) as channel:
+            stub = rpc.LMSStub(channel)
+            # Two wire attempts of the SAME logical request (what the
+            # client's retry loop sends after a lost response).
+            for _ in range(2):
+                resp = stub.GetLLMAnswer(
+                    lms_pb2.QueryRequest(token=student.token, query=query),
+                    timeout=10,
+                    metadata=[(REQUEST_ID_METADATA_KEY, "logical-req-1")],
+                )
+                assert resp.success
+                assert "instructor" in resp.response.lower()
+    finally:
+        stack["injector"].clear("tutoring")
+        # The induced failures may have opened the breaker; close it so
+        # later tests start from the healthy state.
+        stack["breaker"].record_success()
+    queued = [q for q in stack["node"].state.unanswered_queries()
+              if q["query"] == query]
+    assert len(queued) == 1, (
+        f"expected one instructor entry for one logical request, got "
+        f"{len(queued)}"
+    )
+
+
+def test_degraded_fallback_without_request_id_still_queues(stack, student):
+    """Clients that send no x-request-id keep the old per-attempt ids (no
+    dedupe, but never dropped either) — pins the fallback's default."""
+    stack["injector"].configure("tutoring", drop=1.0)
+    query = "degraded question without idempotency key"
+    try:
+        with grpc.insecure_channel(stack["address"]) as channel:
+            stub = rpc.LMSStub(channel)
+            resp = stub.GetLLMAnswer(
+                lms_pb2.QueryRequest(token=student.token, query=query),
+                timeout=10,
+            )
+            assert resp.success and "instructor" in resp.response.lower()
+    finally:
+        stack["injector"].clear("tutoring")
+        stack["breaker"].record_success()  # close again for later tests
+    queued = [q for q in stack["node"].state.unanswered_queries()
+              if q["query"] == query]
+    assert len(queued) == 1
+
+
+def test_duplicate_fault_delivers_tutoring_query_twice(stack, student):
+    """ROADMAP item (b): the "duplicate" fault is now real on the tutoring
+    hop — the forward is delivered twice (idempotent: same success, extra
+    compute only), it counts as injected, and the tutoring node really
+    sees both deliveries."""
+    before = (stack["tut_metrics"].snapshot()["counters"]
+              .get("llm_requests", 0))
+    injected_before = stack["injector"].snapshot()["injected_total"]
+    stack["injector"].configure("tutoring", duplicate=1.0)
+    try:
+        resp = student.ask_llm("duplicated question?")
+    finally:
+        stack["injector"].clear("tutoring")
+    assert resp.success
+    assert "instructor" not in resp.response.lower()  # not degraded
+    after = stack["tut_metrics"].snapshot()["counters"]["llm_requests"]
+    assert after == before + 2, "tutoring must see both deliveries"
+    assert stack["injector"].snapshot()["injected_total"] > injected_before
+    assert (stack["metrics"].snapshot()["counters"]
+            .get("tutoring_duplicates", 0) >= 1)
 
 
 # ----------------------------------------------------------- chaos over gRPC
