@@ -179,15 +179,50 @@ def run_lint(
     root: Optional[Path] = None,
 ) -> List[Finding]:
     """Run `rules` (default: all registered) over `paths` (default: the
-    package + scripts + tests). Returns unsuppressed findings, sorted."""
+    package + scripts + tests). Returns unsuppressed findings, sorted.
+
+    Per-file rules see exactly the requested sources. Project rules
+    (`analysis.project.ProjectRule`) always analyze the FULL default tree
+    — a call graph over half a repo proves nothing — but only report
+    findings inside the requested paths, so `scripts/lint.py engine/`
+    stays scoped; absence-style rules (`full_project_only`) additionally
+    skip subset runs entirely rather than report on partial evidence.
+    """
+    from .project import Project, ProjectRule  # local: avoids import cycle
+
     root = root or repo_root()
     active = list(rules) if rules is not None else all_rules()
+    file_rules = [r for r in active if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in active if isinstance(r, ProjectRule)]
+    selected = iter_sources(paths, root=root)
     findings: List[Finding] = []
-    for src in iter_sources(paths, root=root):
-        for rule in active:
+    for src in selected:
+        for rule in file_rules:
             if not rule.applies_to(src.rel):
                 continue
             for f in rule.check(src):
                 if not src.suppressed(f.rule, f.line):
                     findings.append(f)
+    full_run = paths is None
+    # Absence-style rules are filtered BEFORE the (repo-wide) project
+    # build, so a scoped run whose project rules would all skip doesn't
+    # parse the whole tree for nothing.
+    project_rules = [
+        r for r in project_rules if full_run or not r.full_project_only
+    ]
+    if project_rules:
+        sources = selected if full_run else iter_sources(None, root=root)
+        project = Project(sources, root=root)
+        selected_rels = {src.rel for src in selected}
+        for rule in project_rules:
+            for f in rule.check_project(project):
+                src = project.sources.get(f.path)
+                if src is not None and src.suppressed(f.rule, f.line):
+                    continue
+                # Findings on files outside the requested subset (or on
+                # non-Python artifacts like configs/*.toml) surface only
+                # on full runs.
+                if not full_run and f.path not in selected_rels:
+                    continue
+                findings.append(f)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,406 @@
+"""Whole-repo semantic model for cross-file lint rules.
+
+PR 3's rules are per-file lexical passes; the incident classes that remain
+— a gRPC egress that drops the client's deadline budget, a typo'd metric
+name shipping an always-zero dashboard panel, a config knob parsed but
+never read — all span files. This module builds the project-wide facts
+those rules need, still as pure AST (nothing here imports the modules it
+models, so the analysis cannot be broken by import side effects and runs
+in milliseconds over the whole tree):
+
+- a symbol table: every module's classes, methods, and functions, keyed by
+  a stable qualified name `<rel-path>::Class.method` / `<rel-path>::func`;
+- an import map per module (`from .service import replicate_file_to_peers`
+  resolves to the defining file when it is inside the project);
+- a call graph with heuristic resolution (bare names -> same module or
+  imports; `self.m()` -> same class, then project-local base classes;
+  `alias.f()` -> imported project module) plus conservative
+  *address-taken* tracking: a function whose reference escapes as an
+  argument or assignment (`apply_cb=self._apply`, a callback handed to
+  `add_done_callback`) is treated as reachable, the standard conservative
+  choice when the caller cannot be seen statically;
+- reachability queries over that graph.
+
+The model is deliberately unsound in the usual static-analysis trade:
+dynamic dispatch through unannotated values is not resolved (those calls
+simply contribute no edge). Rules built on it are therefore tuned so that
+*missing* resolution loses findings rather than inventing them.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Rule, Source
+
+__all__ = [
+    "FunctionInfo",
+    "ClassInfo",
+    "ModuleInfo",
+    "Project",
+    "ProjectRule",
+]
+
+
+class FunctionInfo:
+    """One function or method (nested defs included)."""
+
+    def __init__(
+        self,
+        qname: str,
+        node: ast.AST,
+        src: Source,
+        *,
+        class_name: Optional[str] = None,
+        parent: Optional[str] = None,
+    ):
+        self.qname = qname
+        self.node = node
+        self.src = src
+        self.rel = src.rel
+        self.name = getattr(node, "name", "<lambda>")
+        self.class_name = class_name
+        self.parent = parent            # enclosing function qname, if nested
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"FunctionInfo({self.qname})"
+
+
+class ClassInfo:
+    def __init__(self, node: ast.ClassDef, src: Source):
+        self.node = node
+        self.src = src
+        self.rel = src.rel
+        self.name = node.name
+        self.bases = [_dotted(b) for b in node.bases]
+        self.methods: Dict[str, FunctionInfo] = {}
+
+    def base_names(self) -> List[str]:
+        """Last components of the base expressions ('rpc.LMSServicer' ->
+        'LMSServicer'); '' entries for unresolvable bases are dropped."""
+        out = []
+        for b in self.bases:
+            if b:
+                out.append(b.rsplit(".", 1)[-1])
+        return out
+
+
+class ModuleInfo:
+    def __init__(self, src: Source):
+        self.src = src
+        self.rel = src.rel
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}  # module-level only
+        # local alias -> ("mod", <rel of project module>) for module imports,
+        # or ("sym", <rel>, <name>) for from-imports of a symbol.
+        self.imports: Dict[str, Tuple] = {}
+
+
+def _dotted(node: ast.expr) -> str:
+    """'a.b.c' for Name/Attribute chains; '' when anything else appears."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _rel_to_dotted(rel: str) -> str:
+    """'pkg/sub/mod.py' -> 'pkg.sub.mod' ('pkg/sub/__init__.py' -> 'pkg.sub')."""
+    dotted = rel[:-3] if rel.endswith(".py") else rel
+    dotted = dotted.replace("/", ".")
+    if dotted.endswith(".__init__"):
+        dotted = dotted[: -len(".__init__")]
+    return dotted
+
+
+class Project:
+    """Symbol table + call graph over a set of parsed Sources."""
+
+    def __init__(self, sources: Sequence[Source], *, root: Optional[Path] = None):
+        self.root = Path(root) if root is not None else None
+        self.sources: Dict[str, Source] = {s.rel: s for s in sources}
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}       # "<rel>::Class"
+        self.edges: Dict[str, Set[str]] = {}
+        self.address_taken: Set[str] = set()
+        self._dotted_to_rel = {
+            _rel_to_dotted(rel): rel for rel in self.sources
+        }
+        for src in sources:
+            self._collect_module(src)
+        for src in sources:
+            self._resolve_imports(self.modules[src.rel])
+        for src in sources:
+            self._build_edges(self.modules[src.rel])
+
+    # ------------------------------------------------------------- phase 1
+
+    def _collect_module(self, src: Source) -> None:
+        mod = ModuleInfo(src)
+        self.modules[src.rel] = mod
+
+        def visit_function(
+            node: ast.AST, class_name: Optional[str],
+            parent_qname: Optional[str],
+        ) -> FunctionInfo:
+            local = (
+                f"{class_name}.{node.name}" if class_name else node.name
+            )
+            qname = (
+                f"{parent_qname}.{node.name}" if parent_qname
+                else f"{src.rel}::{local}"
+            )
+            info = FunctionInfo(
+                qname, node, src, class_name=class_name, parent=parent_qname
+            )
+            self.functions[qname] = info
+            if parent_qname is None and class_name is None:
+                mod.functions[node.name] = info
+            for child in node.body:
+                walk(child, class_name=class_name, parent_qname=qname)
+            return info
+
+        def walk(
+            node: ast.AST, class_name: Optional[str] = None,
+            parent_qname: Optional[str] = None,
+        ) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_function(node, class_name, parent_qname)
+            elif isinstance(node, ast.ClassDef) and parent_qname is None:
+                cls = ClassInfo(node, src)
+                mod.classes[node.name] = cls
+                self.classes[f"{src.rel}::{node.name}"] = cls
+                for child in node.body:
+                    if isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        info = visit_function(child, node.name, None)
+                        cls.methods[child.name] = info
+                    else:
+                        walk(child, class_name=node.name)
+            else:
+                for child in ast.iter_child_nodes(node):
+                    walk(child, class_name=class_name,
+                         parent_qname=parent_qname)
+
+        for top in src.tree.body:
+            walk(top)
+
+    # ------------------------------------------------------------- phase 2
+
+    def _resolve_imports(self, mod: ModuleInfo) -> None:
+        pkg_parts = _rel_to_dotted(mod.rel).split(".")[:-1]
+        for node in ast.walk(mod.src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    rel = self._dotted_to_rel.get(alias.name)
+                    if rel is not None:
+                        mod.imports[alias.asname or alias.name.split(".")[0]] \
+                            = ("mod", rel)
+            elif isinstance(node, ast.ImportFrom):
+                base: List[str]
+                if node.level:
+                    # Relative: level 1 = current package, 2 = parent, ...
+                    if node.level - 1 <= len(pkg_parts):
+                        base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    else:
+                        continue
+                    if node.module:
+                        base = base + node.module.split(".")
+                else:
+                    base = (node.module or "").split(".")
+                base_dotted = ".".join(p for p in base if p)
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # `from X import Y`: Y is a submodule or a symbol of X.
+                    sub_dotted = (
+                        f"{base_dotted}.{alias.name}" if base_dotted
+                        else alias.name
+                    )
+                    sub_rel = self._dotted_to_rel.get(sub_dotted)
+                    if sub_rel is not None:
+                        mod.imports[local] = ("mod", sub_rel)
+                        continue
+                    src_rel = self._dotted_to_rel.get(base_dotted)
+                    if src_rel is not None:
+                        mod.imports[local] = ("sym", src_rel, alias.name)
+
+    # ------------------------------------------------------------- phase 3
+
+    def resolve_call(
+        self, mod: ModuleInfo, func_expr: ast.expr,
+        class_name: Optional[str], enclosing: Optional[FunctionInfo],
+    ) -> Optional[FunctionInfo]:
+        """The FunctionInfo a call/reference expression denotes, if the
+        heuristics can see it; None contributes no edge (unsound-by-design,
+        see the module docstring)."""
+        if isinstance(func_expr, ast.Name):
+            name = func_expr.id
+            # Nested def of the enclosing function chain.
+            fn = enclosing
+            while fn is not None:
+                nested = self.functions.get(f"{fn.qname}.{name}")
+                if nested is not None:
+                    return nested
+                fn = self.functions.get(fn.parent) if fn.parent else None
+            if name in mod.functions:
+                return mod.functions[name]
+            target = mod.imports.get(name)
+            if target is not None and target[0] == "sym":
+                _, rel, sym = target
+                other = self.modules.get(rel)
+                if other is not None and sym in other.functions:
+                    return other.functions[sym]
+            return None
+        if isinstance(func_expr, ast.Attribute):
+            value = func_expr.value
+            if isinstance(value, ast.Name) and value.id == "self" \
+                    and class_name is not None:
+                return self._lookup_method(mod, class_name, func_expr.attr)
+            if isinstance(value, ast.Name):
+                target = mod.imports.get(value.id)
+                if target is not None and target[0] == "mod":
+                    other = self.modules.get(target[1])
+                    if other is not None:
+                        return other.functions.get(func_expr.attr)
+        return None
+
+    def _lookup_method(
+        self, mod: ModuleInfo, class_name: str, method: str
+    ) -> Optional[FunctionInfo]:
+        cls = mod.classes.get(class_name)
+        seen = set()
+        while cls is not None and cls.name not in seen:
+            seen.add(cls.name)
+            if method in cls.methods:
+                return cls.methods[method]
+            # Single project-local base hop (diamonds are out of scope).
+            nxt = None
+            for base in cls.bases:
+                head = base.split(".", 1)[0]
+                tail = base.rsplit(".", 1)[-1]
+                owner = self.modules.get(mod.rel)
+                if base in (owner.classes if owner else {}):
+                    nxt = owner.classes[base]
+                    break
+                imp = mod.imports.get(head)
+                if imp is None:
+                    continue
+                if imp[0] == "mod":
+                    other = self.modules.get(imp[1])
+                    if other is not None and tail in other.classes:
+                        nxt = other.classes[tail]
+                        break
+                elif imp[0] == "sym" and imp[2] == base:
+                    other = self.modules.get(imp[1])
+                    if other is not None and base in other.classes:
+                        nxt = other.classes[base]
+                        break
+            cls = nxt
+        return None
+
+    def _build_edges(self, mod: ModuleInfo) -> None:
+        for qname, fn in self.functions.items():
+            if fn.rel != mod.rel:
+                continue
+            edges = self.edges.setdefault(qname, set())
+            # Defining a nested function implies it may run.
+            if fn.parent is not None:
+                self.edges.setdefault(fn.parent, set()).add(qname)
+            # NOTE: ast.walk cannot be pruned, so this walk INCLUDES the
+            # bodies of nested defs — their calls are attributed to this
+            # function as well as to their own FunctionInfo. Harmless for
+            # reachability (the parent->nested edge exists regardless);
+            # rules that report per-site must dedup on (line, col).
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    callee = self.resolve_call(
+                        mod, node.func, fn.class_name, fn
+                    )
+                    if callee is not None:
+                        edges.add(callee.qname)
+                else:
+                    self._note_address_taken(mod, node, fn)
+        # Module-level references (decorators, callback tables, ...).
+        for node in ast.walk(mod.src.tree):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                self._note_address_taken(mod, node, None)
+
+    def _note_address_taken(
+        self, mod: ModuleInfo, node: ast.AST,
+        fn: Optional[FunctionInfo],
+    ) -> None:
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            return
+        parent = getattr(node, "parent", None)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            return  # a plain call, not an escaping reference
+        if isinstance(parent, ast.Attribute):
+            return  # mid-chain (a.b of a.b.c)
+        target = self.resolve_call(
+            mod, node, fn.class_name if fn else None, fn
+        )
+        if target is not None:
+            self.address_taken.add(target.qname)
+
+    # ----------------------------------------------------------- queries
+
+    def reachable(self, roots: Iterable[str]) -> Set[str]:
+        """Transitive closure over call edges from `roots` (qnames)."""
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.edges.get(cur, ()) - seen)
+        return seen
+
+    def handler_roots(self, *, base_suffix: str = "Servicer") -> Set[str]:
+        """Async methods of gRPC servicer classes (a base class named
+        `*Servicer`) — the places client deadline budgets enter a server."""
+        roots: Set[str] = set()
+        for cls in self.classes.values():
+            if not any(b.endswith(base_suffix) for b in cls.base_names()):
+                continue
+            for info in cls.methods.values():
+                if info.is_async:
+                    roots.add(info.qname)
+        return roots
+
+    def functions_in(self, rel_prefixes: Sequence[str]) -> List[FunctionInfo]:
+        return [
+            fn for fn in self.functions.values()
+            if any(fn.rel.startswith(p) for p in rel_prefixes)
+        ]
+
+
+class ProjectRule(Rule):
+    """A rule over the whole Project rather than one Source.
+
+    `check(src)` is intentionally inert (the per-file runner skips these);
+    `check_project(project)` produces the findings. `full_project_only`
+    rules are skipped when the caller linted an explicit subset of files —
+    their absence-style claims ("never read", "not declared") are only
+    meaningful against the complete tree.
+    """
+
+    full_project_only = False
+
+    def applies_to(self, rel: str) -> bool:  # pragma: no cover - unused
+        return False
+
+    def check(self, src: Source) -> List[Finding]:
+        return []
+
+    def check_project(self, project: Project) -> List[Finding]:
+        raise NotImplementedError

@@ -2,13 +2,20 @@
 
 Each module holds one rule targeting one of this codebase's demonstrated
 bug classes (see the module docstrings for the incident each rule encodes).
+Per-file lexical rules came with PR 3; the semantic rules (deadline-flow,
+metrics-registry, config-consistency, guarded-by-flow) run on the
+whole-repo symbol table + call graph in analysis/project.py.
 """
 
 from . import (  # noqa: F401
     async_blocking,
     canonical_pspec,
+    config_consistency,
+    deadline_flow,
     guarded_by,
+    guarded_by_flow,
     host_sync,
+    metrics_registry,
     orphan_task,
     slow_marker,
     tracer_hygiene,

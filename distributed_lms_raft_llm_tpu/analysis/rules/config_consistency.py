@@ -1,0 +1,188 @@
+"""config-consistency: every config knob is real, and every TOML key maps
+to one.
+
+`config.py` already rejects unknown TOML keys at *runtime* — but only
+when that file is actually loaded, and nothing at all catches the
+opposite rot: a dataclass field that is parsed, documented, and then
+read by no code ("dead knob" — operators tune it and nothing changes).
+This rule makes both directions static:
+
+- **every section field must be read somewhere** in the project outside
+  its own declaration: an attribute access `.field_name` anywhere in the
+  tree counts (deliberately name-based and conservative — a shared name
+  like `model` can mask a dead knob, but the check never false-positives
+  on a live one);
+- **every key in `configs/*.toml` must name a declared section/field**,
+  mirroring `load_config`'s strictness without running anything, so a
+  typo'd key in a shipped config fails `scripts/lint.py` rather than a
+  deploy.
+
+The section map is discovered from `AppConfig`'s annotated fields in the
+project's `config.py`, so adding a section is one dataclass edit — the
+rule follows.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, register
+from ..project import Project, ProjectRule
+
+_TOML_SECTION_RE = re.compile(r"^\s*\[([A-Za-z0-9_.\-]+)\]")
+_TOML_KEY_RE = re.compile(r"^\s*([A-Za-z0-9_\-]+)\s*=")
+
+
+def _annotation_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _ConfigModel:
+    """sections: section name -> {field name -> decl line}."""
+
+    def __init__(self) -> None:
+        self.rel: str = ""
+        self.sections: Dict[str, Dict[str, int]] = {}
+
+
+def _parse_config_module(project: Project) -> Optional[_ConfigModel]:
+    for rel in sorted(project.sources):
+        if not rel.endswith("config.py"):
+            continue
+        mod = project.modules[rel]
+        app = mod.classes.get("AppConfig")
+        if app is None:
+            continue
+        model = _ConfigModel()
+        model.rel = rel
+        for stmt in app.node.body:
+            if not isinstance(stmt, ast.AnnAssign) \
+                    or not isinstance(stmt.target, ast.Name):
+                continue
+            section = stmt.target.id
+            cls_name = _annotation_name(stmt.annotation)
+            cls = mod.classes.get(cls_name or "")
+            if cls is None:
+                continue
+            fields: Dict[str, int] = {}
+            for f in cls.node.body:
+                if isinstance(f, ast.AnnAssign) \
+                        and isinstance(f.target, ast.Name):
+                    fields[f.target.id] = f.lineno
+            model.sections[section] = fields
+        return model
+    return None
+
+
+def _attribute_reads(project: Project) -> Set[str]:
+    """Every attribute name read anywhere in the project. The config
+    module's own dataclass bodies contribute nothing (an AnnAssign is not
+    an Attribute access), while its adapter functions legitimately do."""
+    reads: Set[str] = set()
+    for src in project.sources.values():
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                reads.add(node.attr)
+    return reads
+
+
+@register
+class ConfigConsistencyRule(ProjectRule):
+    name = "config-consistency"
+    description = (
+        "config dataclass field no code reads (dead knob: operators tune "
+        "it, nothing changes), or a configs/*.toml key that names no "
+        "declared field (typo'd config ships silently)"
+    )
+    # "never read" is only meaningful against the complete tree.
+    full_project_only = True
+
+    def check_project(self, project: Project) -> List[Finding]:
+        model = _parse_config_module(project)
+        if model is None:
+            return []
+        findings: List[Finding] = []
+        src = project.sources[model.rel]
+        reads = _attribute_reads(project)
+        for section, fields in sorted(model.sections.items()):
+            for field, line in sorted(fields.items(), key=lambda kv: kv[1]):
+                if field not in reads:
+                    findings.append(self.finding(
+                        src, line,
+                        f"[{section}] field {field!r} is parsed but never "
+                        "read anywhere in the project — delete the dead "
+                        "knob or wire it to the code it was meant to "
+                        "configure",
+                    ))
+        findings.extend(self._check_tomls(project, model))
+        return findings
+
+    # ------------------------------------------------------------- TOML
+
+    def _check_tomls(
+        self, project: Project, model: _ConfigModel
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        if project.root is None:
+            return findings
+        configs_dir = project.root / "configs"
+        if not configs_dir.is_dir():
+            return findings
+        for path in sorted(configs_dir.glob("*.toml")):
+            rel = path.relative_to(project.root).as_posix()
+            section: Optional[str] = None
+            known_section = False
+            for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1
+            ):
+                m = _TOML_SECTION_RE.match(line)
+                if m:
+                    parts = m.group(1).split(".")
+                    section = parts[0]
+                    known_section = section in model.sections
+                    if not known_section:
+                        findings.append(Finding(
+                            rule=self.name, path=rel, line=lineno,
+                            message=(
+                                f"[{m.group(1)}] is not a config section "
+                                f"(known: {sorted(model.sections)})"
+                            ),
+                        ))
+                    elif len(parts) > 1:
+                        # [section.sub]: `sub` must be a field (its keys
+                        # are data, e.g. [cluster.nodes] node ids).
+                        sub = parts[1]
+                        if sub not in model.sections[section]:
+                            findings.append(Finding(
+                                rule=self.name, path=rel, line=lineno,
+                                message=(
+                                    f"[{m.group(1)}]: {sub!r} is not a "
+                                    f"field of [{section}] (known: "
+                                    f"{sorted(model.sections[section])})"
+                                ),
+                            ))
+                        section = None  # keys below are free-form data
+                    continue
+                k = _TOML_KEY_RE.match(line)
+                if k and section is not None and known_section:
+                    key = k.group(1)
+                    if key not in model.sections[section]:
+                        findings.append(Finding(
+                            rule=self.name, path=rel, line=lineno,
+                            message=(
+                                f"key {key!r} is not a field of "
+                                f"[{section}] — load_config would reject "
+                                "this file (known: "
+                                f"{sorted(model.sections[section])})"
+                            ),
+                        ))
+        return findings

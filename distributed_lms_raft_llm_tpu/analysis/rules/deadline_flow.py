@@ -1,0 +1,112 @@
+"""deadline-flow: RPC egress reachable from a handler must spend budget,
+not wall-clock constants.
+
+PR 1 threaded one request-scoped `Deadline` through the student-query
+path, but two gRPC egresses kept hardcoded timeouts (`timeout=5` on the
+blob FetchFile sweep, `timeout=30` per peer on upload replication): a
+client whose budget had already expired could still pin this server for
+tens of seconds doing work nobody would receive. This rule makes the
+contract structural: **every gRPC stub call reachable from an RPC
+handler in the request-path modules (`lms/`, `serving/`) must derive its
+`timeout=` from the propagated budget** — a numeric literal there is a
+finding.
+
+Mechanics (analysis/project.py):
+
+- roots are the async methods of `*Servicer` subclasses plus every
+  address-taken function (callbacks like `apply_cb=self._apply` run on
+  the same loop in response to the same RPCs, which is exactly how the
+  post-commit replication sweep is reached);
+- reachability is the call-graph closure over those roots;
+- a "gRPC stub egress" is a method call whose attribute is CamelCase —
+  the proto naming convention (`FetchFile`, `SendFile`, `GetLLMAnswer`)
+  that separates wire RPCs from snake_case helpers like
+  `asyncio.wait_for` in this codebase;
+- the finding fires on `timeout=<int|float literal>` at such a call. A
+  timeout *expression* (`deadline.timeout(cap=...)`, `max(floor, ...)`)
+  is the fix shape and never flags, so the rule cannot pester correct
+  code into suppressions.
+
+Raft-internal RPC timing (`raft/grpc_transport.py`) is deliberately out
+of scope: heartbeat-scale protocol timeouts are a consensus-liveness
+knob, not a client budget.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Tuple
+
+from ..core import Finding, register
+from ..project import Project, ProjectRule
+
+# Request-path modules: where client deadline budgets live.
+DEFAULT_WATCH = (
+    "distributed_lms_raft_llm_tpu/lms/",
+    "distributed_lms_raft_llm_tpu/serving/",
+)
+
+
+def _literal_timeout(call: ast.Call) -> Tuple[bool, object]:
+    for kw in call.keywords:
+        if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, (int, float)) \
+                and not isinstance(kw.value.value, bool):
+            return True, kw.value.value
+    return False, None
+
+
+def _stub_egress_name(call: ast.Call) -> str:
+    """The CamelCase RPC method name, or '' when not a stub egress."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr[:1].isupper():
+        return func.attr
+    return ""
+
+
+@register
+class DeadlineFlowRule(ProjectRule):
+    name = "deadline-flow"
+    description = (
+        "gRPC stub egress reachable from an RPC handler with a hardcoded "
+        "numeric `timeout=` — the client's propagated Deadline budget is "
+        "dropped on the floor; derive the timeout from it "
+        "(utils/resilience.Deadline.timeout)"
+    )
+
+    def __init__(self, watch_prefixes: Sequence[str] = DEFAULT_WATCH):
+        self.watch_prefixes = tuple(watch_prefixes)
+
+    def check_project(self, project: Project) -> List[Finding]:
+        roots = project.handler_roots() | project.address_taken
+        reachable = project.reachable(roots)
+        findings: List[Finding] = []
+        seen = set()
+        for fn in project.functions_in(self.watch_prefixes):
+            if fn.qname not in reachable:
+                continue
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                rpc = _stub_egress_name(node)
+                if not rpc:
+                    continue
+                hardcoded, value = _literal_timeout(node)
+                if not hardcoded:
+                    continue
+                # col_offset keeps two egresses sharing a line distinct;
+                # the dedup only collapses the nested-def re-walk.
+                key = (fn.rel, node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(self.finding(
+                    fn.src, node,
+                    f"{rpc}(..., timeout={value}) is reachable from an RPC "
+                    "handler but ignores the request's propagated Deadline "
+                    "budget — an expired client can still pin this server "
+                    f"for {value}s; derive the timeout from the active "
+                    "budget (Deadline.timeout(cap=...)) with a configured "
+                    "floor/cap in [resilience]",
+                ))
+        return findings

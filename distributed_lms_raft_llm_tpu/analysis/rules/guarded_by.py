@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..core import Finding, Rule, Source, register
 
@@ -242,7 +242,7 @@ class GuardedByRule(Rule):
         return findings
 
     @staticmethod
-    def _mutations(node: ast.AST):
+    def _mutations(node: ast.AST) -> "Iterator[Tuple[str, str]]":
         """Yield (attr, description) for mutations of self.<attr>."""
         if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
             targets = (

@@ -1,0 +1,123 @@
+"""guarded-by-flow: event-loop confinement checked through the call graph.
+
+The lexical `guarded-by` rule (guarded_by.py) catches the direct escapes:
+a lambda or local `def` handed straight to `run_in_executor`/`submit`/
+`Thread` that mutates `# guarded-by: event-loop` state. Its blind spot is
+one indirection away — the exact shape real code grows into:
+
+    class Queue:
+        def __init__(self):
+            self._futures = {}          # guarded-by: event-loop
+
+        def _reap(self):                # looks loop-confined...
+            self._futures.clear()
+
+        async def run(self, loop):
+            await loop.run_in_executor(None, self._reap)   # ...but is not
+
+`self._reap` is an *attribute reference*, not a name in the enclosing
+function, so the lexical scan never connects the executor call to the
+mutation — and neither does it follow `_reap` calling a second helper
+that does the mutating. This rule closes that with analysis/project.py:
+
+1. seed the **thread-context set** with every function whose reference is
+   passed to an executor/thread constructor anywhere in the project
+   (`self._reap`, a bare helper name, a `target=` keyword);
+2. close it over the call graph (a helper called from thread context runs
+   in thread context);
+3. flag any mutation of an event-loop-guarded attribute inside a
+   thread-context method of the declaring class.
+
+Lock-guarded (`guarded-by: _lock`) state is exempt here: locks are
+thread-safe by design, and the lexical rule already checks them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, register
+from ..project import Project, ProjectRule
+from .guarded_by import EVENT_LOOP, GuardedByRule, _collect
+
+_EXECUTOR_FUNCS = {"run_in_executor", "submit", "Thread", "Timer"}
+
+
+def _is_executor_call(call: ast.Call) -> bool:
+    func = call.func
+    name = (
+        func.attr if isinstance(func, ast.Attribute)
+        else func.id if isinstance(func, ast.Name) else ""
+    )
+    return name in _EXECUTOR_FUNCS
+
+
+@register
+class GuardedByFlowRule(ProjectRule):
+    name = "guarded-by-flow"
+    description = (
+        "event-loop-confined state (`# guarded-by: event-loop`) mutated by "
+        "a method that reaches executor/thread context through the call "
+        "graph (a method reference passed to run_in_executor/submit/"
+        "Thread, or a helper such a method calls)"
+    )
+
+    def check_project(self, project: Project) -> List[Finding]:
+        # (rel, class) -> set of event-loop guarded attribute names.
+        loop_guarded: Dict[Tuple[str, str], Set[str]] = {}
+        for key, cls in project.classes.items():
+            info = _collect(cls.src, cls.node)
+            attrs = {a for a, g in info.guards.items() if g == EVENT_LOOP}
+            if attrs:
+                loop_guarded[(cls.rel, cls.name)] = attrs
+        if not loop_guarded:
+            return []
+
+        # 1. Seed: function references escaping into executors/threads.
+        seeds: Set[str] = set()
+        for fn in project.functions.values():
+            mod = project.modules[fn.rel]
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call) \
+                        or not _is_executor_call(node):
+                    continue
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    target = project.resolve_call(
+                        mod, arg, fn.class_name, fn
+                    )
+                    if target is not None:
+                        seeds.add(target.qname)
+        if not seeds:
+            return []
+
+        # 2. Close over the call graph.
+        thread_ctx = project.reachable(seeds)
+
+        # 3. Mutations of loop-confined attrs inside thread-context methods.
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int]] = set()
+        for qname in sorted(thread_ctx):
+            fn = project.functions[qname]
+            if fn.class_name is None:
+                continue
+            attrs = loop_guarded.get((fn.rel, fn.class_name))
+            if not attrs:
+                continue
+            for node in ast.walk(fn.node):
+                for attr, mutation in GuardedByRule._mutations(node):
+                    if attr not in attrs:
+                        continue
+                    key = (fn.rel, node.lineno, node.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(self.finding(
+                        fn.src, node,
+                        f"self.{attr} is event-loop-confined (guarded-by: "
+                        f"{EVENT_LOOP}) but {fn.class_name}.{fn.name} runs "
+                        "in executor/thread context (its reference — or a "
+                        "caller's — is handed to run_in_executor/submit/"
+                        f"Thread), so this {mutation} races the loop",
+                    ))
+        return findings

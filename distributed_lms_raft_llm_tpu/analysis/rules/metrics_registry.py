@@ -1,0 +1,347 @@
+"""metrics-registry: every emitted metric name is declared exactly once.
+
+A typo'd metric name is the quietest bug in a serving stack: the emitting
+code keeps running, the dashboard panel reads zero forever, and the first
+time anyone notices is mid-incident. `utils/metrics_registry.py` is now
+the single declaration point (name + kind + help string); this rule reads
+its declarations as pure AST and proves, project-wide:
+
+- every name handed to `Metrics.inc/set_gauge/hist/time` in the package
+  is **declared** — a literal must appear in the registry; a dynamic
+  expression must be *rooted at the registry module* (e.g.
+  `metric.TUTORING_DEGRADED`, `metric.BREAKER_TRANSITION_COUNTERS[new]`),
+  which is declared-by-construction;
+- names flow through **one forwarding hop**: a helper whose parameter is
+  passed straight into a metrics primitive (`def _inc(self, name):
+  self.metrics.inc(name)`) has its *call sites* checked instead, so the
+  batcher wrappers don't force suppressions;
+- the **registry itself is well-formed**: literal-only declarations (the
+  rule must be able to read them without importing), no duplicates, no
+  empty help strings;
+- every declared series is **emitted somewhere** — a stale declaration
+  would put a dead row in the README table the registry renders.
+
+Truly dynamic names (the generic `LoopWatchdog`'s `f"{name}_lag"`)
+carry a visible `# lint: disable=metrics-registry` with the wiring site
+that pins the concrete names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, register
+from ..project import FunctionInfo, ModuleInfo, Project, ProjectRule
+
+REGISTRY_FILENAME = "metrics_registry.py"
+_DECL_FUNCS = {"counter", "gauge", "histogram"}
+_EMIT_METHODS = {"inc", "set_gauge", "hist", "time"}
+# Receivers that denote a Metrics object: `metrics.inc(...)`,
+# `self.metrics.inc(...)`, `self._metrics.inc(...)`.
+_METRICS_RECEIVERS = {"metrics", "_metrics"}
+
+DEFAULT_WATCH = ("distributed_lms_raft_llm_tpu/",)
+DEFAULT_EXCLUDE = (
+    # The Metrics implementation itself and the declaration point.
+    "distributed_lms_raft_llm_tpu/utils/metrics.py",
+    "distributed_lms_raft_llm_tpu/utils/" + REGISTRY_FILENAME,
+)
+
+
+def _is_metrics_call(call: ast.Call) -> bool:
+    func = call.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _EMIT_METHODS:
+        return False
+    recv = func.value
+    if isinstance(recv, ast.Name):
+        return recv.id in _METRICS_RECEIVERS
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in _METRICS_RECEIVERS
+    return False
+
+
+def _name_arg(call: ast.Call) -> Optional[ast.expr]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def _expr_root(expr: ast.expr) -> Optional[str]:
+    """The leftmost Name of an Attribute/Subscript chain, else None."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self.rel: Optional[str] = None
+        self.names: Dict[str, int] = {}      # metric name -> decl line
+        self.problems: List[Tuple[int, str]] = []
+
+
+def _parse_registry(project: Project, registry_rel: str) -> _Registry:
+    reg = _Registry()
+    reg.rel = registry_rel
+    src = project.sources[registry_rel]
+    for node in src.tree.body:
+        calls: List[ast.Call] = []
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            calls.append(node.value)
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            calls.append(node.value)
+        for call in calls:
+            fname = (
+                call.func.id if isinstance(call.func, ast.Name)
+                else call.func.attr if isinstance(call.func, ast.Attribute)
+                else ""
+            )
+            if fname not in _DECL_FUNCS:
+                continue
+            args = list(call.args)
+            name_node = args[0] if args else None
+            help_node = args[1] if len(args) > 1 else None
+            for kw in call.keywords:
+                if kw.arg == "name":
+                    name_node = kw.value
+                if kw.arg == "help":
+                    help_node = kw.value
+            if not (isinstance(name_node, ast.Constant)
+                    and isinstance(name_node.value, str)):
+                reg.problems.append((
+                    call.lineno,
+                    f"{fname}() declaration must use a literal metric name "
+                    "(the lint rule reads this file without importing it)",
+                ))
+                continue
+            name = name_node.value
+            if name in reg.names:
+                reg.problems.append((
+                    call.lineno,
+                    f"metric {name!r} declared twice (first at line "
+                    f"{reg.names[name]})",
+                ))
+                continue
+            if not (isinstance(help_node, ast.Constant)
+                    and isinstance(help_node.value, str)
+                    and help_node.value.strip()):
+                reg.problems.append((
+                    call.lineno,
+                    f"metric {name!r} needs a non-empty literal help string",
+                ))
+                continue
+            reg.names[name] = call.lineno
+    return reg
+
+
+@register
+class MetricsRegistryRule(ProjectRule):
+    name = "metrics-registry"
+    description = (
+        "metric name emitted somewhere in the package that is not declared "
+        "in utils/metrics_registry.py (typo / undocumented series), or a "
+        "declared series no code emits"
+    )
+    # "never declared / never emitted" claims need the whole tree.
+    full_project_only = True
+
+    def __init__(
+        self,
+        watch_prefixes: Sequence[str] = DEFAULT_WATCH,
+        exclude_rels: Sequence[str] = DEFAULT_EXCLUDE,
+    ):
+        self.watch_prefixes = tuple(watch_prefixes)
+        self.exclude_rels = tuple(exclude_rels)
+
+    # ------------------------------------------------------------ helpers
+
+    def _registry_rel(self, project: Project) -> Optional[str]:
+        for rel in sorted(project.sources):
+            # This rule module shares the basename; the declaration point
+            # lives outside analysis/.
+            if rel.rsplit("/", 1)[-1] == REGISTRY_FILENAME \
+                    and "analysis" not in rel.split("/") \
+                    and any(rel.startswith(p) for p in self.watch_prefixes):
+                return rel
+        return None
+
+    def _registry_rooted(
+        self, mod: ModuleInfo, expr: ast.expr, registry_rel: str
+    ) -> bool:
+        root = _expr_root(expr)
+        if root is None:
+            return False
+        target = mod.imports.get(root)
+        if target is None:
+            return False
+        if target[0] == "mod" and target[1] == registry_rel:
+            return True
+        # `from ..utils.metrics_registry import TUTORING_DEGRADED`
+        return target[0] == "sym" and target[1] == registry_rel
+
+    def _find_forwarders(self, project: Project) -> Dict[str, str]:
+        """qname -> forwarded param name, for helpers that pass their first
+        non-self parameter straight into a metrics primitive."""
+        forwarders: Dict[str, str] = {}
+        for qname, fn in project.functions.items():
+            args = fn.node.args.args
+            params = [a.arg for a in args if a.arg != "self"]
+            if not params:
+                continue
+            first = params[0]
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call) and _is_metrics_call(node):
+                    arg = _name_arg(node)
+                    if isinstance(arg, ast.Name) and arg.id == first:
+                        forwarders[qname] = first
+                        break
+        return forwarders
+
+    # -------------------------------------------------------------- check
+
+    def check_project(self, project: Project) -> List[Finding]:
+        registry_rel = self._registry_rel(project)
+        if registry_rel is None:
+            return []  # no registry in this project (partial/fixture tree)
+        registry = _parse_registry(project, registry_rel)
+        reg_src = project.sources[registry_rel]
+        findings: List[Finding] = [
+            self.finding(reg_src, line, msg)
+            for line, msg in registry.problems
+        ]
+        forwarders = self._find_forwarders(project)
+        emitted: Set[str] = set()
+        seen: Set[Tuple[str, int]] = set()
+
+        for fn in project.functions.values():
+            if not any(fn.rel.startswith(p) for p in self.watch_prefixes):
+                continue
+            if fn.rel in self.exclude_rels or fn.rel == registry_rel:
+                continue
+            mod = project.modules[fn.rel]
+            own_forward_param = forwarders.get(fn.qname)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_metrics_call(node):
+                    arg = _name_arg(node)
+                else:
+                    callee = project.resolve_call(
+                        mod, node.func, fn.class_name, fn
+                    )
+                    if callee is None or callee.qname not in forwarders:
+                        continue
+                    arg = node.args[0] if node.args else None
+                if arg is None:
+                    continue
+                # Collapse the parent-function re-walk of nested-def
+                # bodies ONLY: col_offset keeps two emissions sharing a
+                # source line distinct.
+                key = (fn.rel, node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                # `"a" if cond else "b"` names two series; check both.
+                branches = (
+                    [arg.body, arg.orelse] if isinstance(arg, ast.IfExp)
+                    else [arg]
+                )
+                if all(isinstance(b, ast.Constant)
+                       and isinstance(b.value, str) for b in branches):
+                    for b in branches:
+                        emitted.add(b.value)
+                        if b.value not in registry.names:
+                            findings.append(self.finding(
+                                fn.src, node,
+                                f"metric name {b.value!r} is not declared "
+                                f"in {registry_rel} — a typo here ships an "
+                                "always-zero dashboard panel; declare it "
+                                "with a help string (or fix the spelling)",
+                            ))
+                    continue
+                if isinstance(arg, ast.Name) and arg.id == own_forward_param:
+                    continue  # the forwarding seam; call sites are checked
+                if self._registry_rooted(mod, arg, registry_rel):
+                    continue  # registry constants are declared by construction
+                findings.append(self.finding(
+                    fn.src, node,
+                    "metric name is not statically checkable (dynamic "
+                    "expression); use a string literal or a constant/"
+                    "mapping from the metrics registry so the series "
+                    "stays declared",
+                ))
+
+        # Declared-but-never-emitted: a dead registry row becomes a dead
+        # row in the rendered docs. A name counts as emitted when it
+        # appears literally at an emission site, or when some watched
+        # module references the registry constant (or constant-valued
+        # mapping) that carries it.
+        referenced = self._constant_referenced_names(
+            project, registry_rel, registry.names
+        )
+        for name, line in sorted(registry.names.items()):
+            if name not in emitted and name not in referenced:
+                findings.append(self.finding(
+                    reg_src, line,
+                    f"metric {name!r} is declared but nothing emits it — "
+                    "delete the declaration or wire the emission",
+                ))
+        return findings
+
+    def _constant_referenced_names(
+        self, project: Project, registry_rel: str, names: Dict[str, int]
+    ) -> Set[str]:
+        """Names bound to module-level registry constants (or grouped in
+        module-level dict literals) that some watched module references."""
+        src = project.sources[registry_rel]
+        const_to_name: Dict[str, Set[str]] = {}
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target = node.target
+            else:
+                continue
+            if not isinstance(target, ast.Name):
+                continue
+            bound: Set[str] = set()
+            if isinstance(node.value, ast.Call):
+                call = node.value
+                if call.args and isinstance(call.args[0], ast.Constant) \
+                        and isinstance(call.args[0].value, str):
+                    bound.add(call.args[0].value)
+            elif isinstance(node.value, ast.Dict):
+                for v in node.value.values:
+                    if isinstance(v, ast.Name) and v.id in const_to_name:
+                        bound |= const_to_name[v.id]
+                    elif isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        bound.add(v.value)
+            if bound:
+                const_to_name[target.id] = bound & set(names)
+        referenced: Set[str] = set()
+        for rel, mod in project.modules.items():
+            if rel == registry_rel or not any(
+                rel.startswith(p) for p in self.watch_prefixes
+            ):
+                continue
+            for node in ast.walk(mod.src.tree):
+                const = None
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Name):
+                    target = mod.imports.get(node.value.id)
+                    if target is not None and target[0] == "mod" \
+                            and target[1] == registry_rel:
+                        const = node.attr
+                elif isinstance(node, ast.Name):
+                    target = mod.imports.get(node.id)
+                    if target is not None and target[0] == "sym" \
+                            and target[1] == registry_rel:
+                        const = target[2]
+                if const is not None and const in const_to_name:
+                    referenced |= const_to_name[const]
+        return referenced

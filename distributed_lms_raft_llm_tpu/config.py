@@ -122,6 +122,13 @@ class ResilienceConfig:
     breaker_failure_threshold: int = 5
     breaker_recovery_s: float = 10.0
     breaker_half_open_max: int = 1
+    # Intra-cluster file RPCs (lms/service.py). Each per-peer attempt is
+    # capped by these AND by the live budget: the requester's remaining
+    # deadline for blob fetch-on-miss, one replication budget per upload
+    # for the leader's SendFile sweep (anti-entropy heals skipped peers).
+    blob_fetch_timeout_s: float = 5.0   # per-peer FetchFile cap
+    replicate_timeout_s: float = 30.0   # per-peer SendFile cap
+    replicate_budget_s: float = 60.0    # whole-sweep budget per upload
     # Tutoring admission (engine/batcher.py); 0 = unbounded.
     queue_depth: int = 64
     # utils/faults.py seed for the chaos admin plane.

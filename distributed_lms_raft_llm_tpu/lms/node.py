@@ -25,6 +25,7 @@ from ..raft import FileStorage, RaftConfig, RaftNode, decode_command
 from ..raft.grpc_transport import GrpcTransport
 from ..raft.messages import Entry
 from ..utils.guards import make_tick_watchdog
+from ..utils.resilience import Deadline
 from .persistence import BlobStore, SnapshotStore
 from .service import replicate_file_to_peers
 from .state import LMSState
@@ -44,6 +45,8 @@ class LMSNode:
         snapshot_every: int = 64,
         fault_injector=None,
         metrics=None,
+        replicate_timeout_s: float = 30.0,
+        replicate_budget_s: float = 60.0,
     ):
         # snapshot_every > 1 amortizes the full-state JSON rewrite (the WAL
         # already guarantees durability; on crash, at most snapshot_every
@@ -57,6 +60,11 @@ class LMSNode:
         self.snapshot_every = max(1, snapshot_every)
         self._applies_since_snapshot = 0
         self._last_applied_index = applied
+        self.metrics = metrics
+        # [resilience] replicate_timeout_s / replicate_budget_s: per-peer
+        # cap and whole-sweep budget for post-commit upload replication.
+        self._replicate_timeout_s = replicate_timeout_s
+        self._replicate_budget_s = replicate_budget_s
 
         storage = FileStorage(os.path.join(data_dir, "raft_wal.jsonl"))
         transport = transport or GrpcTransport(self.addresses)
@@ -149,7 +157,13 @@ class LMSNode:
             rel = args["filepath"]
             task = asyncio.ensure_future(
                 replicate_file_to_peers(
-                    self.addresses, self.node_id, self.blobs, rel
+                    self.addresses, self.node_id, self.blobs, rel,
+                    per_peer_timeout_s=self._replicate_timeout_s,
+                    # One budget for the whole sweep: a wedged follower
+                    # cannot stack per-peer caps into minutes of leader
+                    # loop time per upload.
+                    deadline=Deadline.after(self._replicate_budget_s),
+                    metrics=self.metrics,
                 )
             )
             task.add_done_callback(_log_replication_result)

@@ -35,7 +35,7 @@ import grpc
 
 from ..proto import lms_pb2, rpc
 from ..raft import NotLeader, TransferInFlight, encode_command
-from ..utils import pdf
+from ..utils import metrics_registry, pdf
 from ..utils.auth import sign_query
 from ..utils.faults import FaultInjected, FaultInjector
 from ..utils.metrics import Metrics
@@ -70,6 +70,7 @@ class LMSServicer(rpc.LMSServicer):
         fault_injector: Optional[FaultInjector] = None,
         tutoring_timeout_s: float = 120.0,
         deadline_floor_s: float = 0.25,
+        blob_fetch_timeout_s: float = 5.0,
     ):
         self.node = node
         self.state = state
@@ -94,6 +95,7 @@ class LMSServicer(rpc.LMSServicer):
         self.faults = fault_injector
         self._tutoring_timeout_s = tutoring_timeout_s
         self._deadline_floor_s = deadline_floor_s
+        self._blob_fetch_timeout_s = blob_fetch_timeout_s
         # Peer map for blob anti-entropy (fetch-on-miss); empty = disabled.
         # Kept as a LIVE reference (no copy): the caller passes the same
         # mapping runtime membership changes mutate (LMSNode.addresses), so
@@ -164,7 +166,10 @@ class LMSServicer(rpc.LMSServicer):
 
     def _on_breaker_change(self, old: str, new: str) -> None:
         log.warning("tutoring breaker %s -> %s", old, new)
-        self.metrics.inc(f"tutoring_breaker_{new}")
+        # Transition counters come from the registry's state mapping, so
+        # the series stay declared (metrics-registry lint rule) even
+        # though the state arrives at runtime.
+        self.metrics.inc(metrics_registry.BREAKER_TRANSITION_COUNTERS[new])
         self.metrics.set_gauge(
             "tutoring_breaker_state", CircuitBreaker._STATE_CODES[new]
         )
@@ -210,7 +215,8 @@ class LMSServicer(rpc.LMSServicer):
             "'instructor responses' later for the answer.",
         )
 
-    async def _blob(self, rel_path: str) -> bytes:
+    async def _blob(self, rel_path: str,
+                    deadline: Optional[Deadline] = None) -> bytes:
         """Blob bytes for committed metadata; fetch-on-miss from peers.
 
         A node can hold committed metadata without the blob (it missed the
@@ -219,6 +225,14 @@ class LMSServicer(rpc.LMSServicer):
         `success=True` with empty file bytes, pull the blob from a peer
         (leader first) via the additive `FetchFile` RPC and store it, so the
         miss heals permanently.
+
+        `deadline` is the calling RPC's propagated budget: each per-peer
+        attempt spends the remaining budget (capped at
+        `[resilience] blob_fetch_timeout_s`), and once it falls under
+        `deadline_floor_s` the sweep stops — a client that has already
+        given up must not pin this node on a doomed peer walk
+        (`blob_fetch_budget_exhausted`). No deadline = the capped legacy
+        behavior.
         """
         loop = asyncio.get_running_loop()
         content = await loop.run_in_executor(None, self.blobs.get, rel_path)
@@ -235,6 +249,22 @@ class LMSServicer(rpc.LMSServicer):
         for pid in ordered:
             if pid == self._self_id:
                 continue
+            # Re-read the live budget per attempt: earlier peers have been
+            # eating it. The floor is checked against the REMAINING budget,
+            # not the cap-limited timeout — a tight blob_fetch_timeout_s
+            # must shorten attempts, never disable the sweep outright.
+            attempt_timeout = self._blob_fetch_timeout_s
+            if deadline is not None:
+                if deadline.remaining() <= self._deadline_floor_s:
+                    self.metrics.inc("blob_fetch_budget_exhausted")
+                    log.info(
+                        "blob fetch %s: deadline budget exhausted before "
+                        "the peer sweep finished", rel_path,
+                    )
+                    return b""  # metadata-only; anti-entropy heals later
+                attempt_timeout = deadline.timeout(
+                    cap=self._blob_fetch_timeout_s
+                )
             try:
                 # Same 50 MiB cap the upload path accepts — the default
                 # 4 MiB receive cap would make any larger blob unfetchable.
@@ -245,7 +275,8 @@ class LMSServicer(rpc.LMSServicer):
                 ) as channel:
                     stub = rpc.FileTransferServiceStub(channel)
                     resp = await stub.FetchFile(
-                        lms_pb2.FetchFileRequest(path=rel_path), timeout=5
+                        lms_pb2.FetchFileRequest(path=rel_path),
+                        timeout=attempt_timeout,
                     )
                 if resp.found:
                     await loop.run_in_executor(
@@ -428,6 +459,9 @@ class LMSServicer(rpc.LMSServicer):
             return lms_pb2.GetResponse(success=False)
         username, role = auth
         entries = []
+        # The client's remaining budget bounds every blob fetch-on-miss
+        # this read triggers (see _blob); None = no budget sent.
+        deadline = Deadline.from_grpc_context(context)
 
         if request.type == "course_material" and role == "student":
             materials = self.state.data["course_materials"]
@@ -436,7 +470,8 @@ class LMSServicer(rpc.LMSServicer):
                     success=True, message="No course materials available."
                 )
             for material in materials:
-                content = await self._blob(material["filepath"])
+                content = await self._blob(material["filepath"],
+                                           deadline=deadline)
                 entries.append(
                     lms_pb2.DataEntry(
                         id="1",
@@ -450,7 +485,8 @@ class LMSServicer(rpc.LMSServicer):
         if request.type == "student_list" and role == "instructor":
             for student, assignments in self.state.data["assignments"].items():
                 for assignment in assignments:
-                    content = await self._blob(assignment["filepath"])
+                    content = await self._blob(assignment["filepath"],
+                                               deadline=deadline)
                     entries.append(
                         lms_pb2.DataEntry(
                             id=student,
@@ -711,12 +747,23 @@ async def replicate_file_to_peers(
     self_id: int,
     blobs: BlobStore,
     rel_path: str,
+    *,
+    per_peer_timeout_s: float = 30.0,
+    deadline: Optional[Deadline] = None,
+    metrics: Optional[Metrics] = None,
 ) -> Dict[int, str]:
     """Leader-side: stream one blob to every peer in 1 MB chunks.
 
     Returns {peer_id: status}. Failures are logged, not fatal — a follower
-    that missed a file can refetch via ReplicateData or serve metadata-only
-    (the reference aborted the apply on replication errors).
+    that missed a file can refetch via FetchFile anti-entropy or serve
+    metadata-only (the reference aborted the apply on replication errors).
+
+    Each peer's SendFile spends the sweep's remaining `deadline` budget
+    (capped at `per_peer_timeout_s`, `[resilience] replicate_timeout_s`):
+    one slow follower can no longer serialize `per_peer_timeout_s × peers`
+    of leader loop time per upload. Peers the budget never reaches are
+    recorded (and counted, `replicate_budget_exhausted`) rather than
+    silently attempted late — the fetch-on-miss path heals them.
     """
     data = blobs.get(rel_path)
     if data is None:
@@ -727,6 +774,14 @@ async def replicate_file_to_peers(
     for peer, addr in list(addresses.items()):
         if peer == self_id:
             continue
+        attempt_timeout = per_peer_timeout_s
+        if deadline is not None:
+            attempt_timeout = deadline.timeout(cap=per_peer_timeout_s)
+            if attempt_timeout <= 0.0 or deadline.expired:
+                results[peer] = "skipped: replication budget exhausted"
+                if metrics is not None:
+                    metrics.inc("replicate_budget_exhausted")
+                continue
         try:
             async with grpc.aio.insecure_channel(addr) as channel:
                 stub = rpc.FileTransferServiceStub(channel)
@@ -738,7 +793,7 @@ async def replicate_file_to_peers(
                             destination_path=rel_path,
                         )
 
-                resp = await stub.SendFile(chunks(), timeout=30)
+                resp = await stub.SendFile(chunks(), timeout=attempt_timeout)
                 results[peer] = resp.status
         except grpc.RpcError as e:
             results[peer] = f"error: {e.code()}"

@@ -23,7 +23,17 @@ from __future__ import annotations
 
 import enum
 import random
-from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+    Union,
+)
 
 from .messages import (
     NOOP,
@@ -78,7 +88,7 @@ class RaftCore:
         self,
         node_id: int,
         peer_ids: Sequence[int],
-        storage,
+        storage: Any,  # raft.storage.FileStorage-shaped (duck-typed in sims)
         config: Optional[RaftConfig] = None,
         *,
         now: float = 0.0,
@@ -462,7 +472,9 @@ class RaftCore:
 
     # Append handling -----------------------------------------------------
 
-    def append_request_for(self, peer: int, now: Optional[float] = None):
+    def append_request_for(
+        self, peer: int, now: Optional[float] = None
+    ) -> Optional[Union[AppendRequest, InstallSnapshotRequest]]:
         """Build the next AppendEntries for `peer` from its next_index — or
         an InstallSnapshot when the peer needs entries the log has compacted
         away (Raft §7: the snapshot replaces the missing prefix). Returns

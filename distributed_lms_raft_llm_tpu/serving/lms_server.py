@@ -75,6 +75,8 @@ async def serve_async(args) -> None:
         # Wires the Raft tick-lag watchdog (utils/guards.py) into /metrics:
         # raft_tick_lag histogram + raft_tick_stalls counter.
         metrics=metrics,
+        replicate_timeout_s=args.replicate_timeout,
+        replicate_budget_s=args.replicate_budget,
     )
 
     gate = None
@@ -123,6 +125,7 @@ async def serve_async(args) -> None:
         fault_injector=faults,
         tutoring_timeout_s=args.tutoring_timeout,
         deadline_floor_s=args.deadline_floor,
+        blob_fetch_timeout_s=args.blob_fetch_timeout,
     )
     server = grpc.aio.server(
         options=[
@@ -290,6 +293,17 @@ def main(argv=None) -> None:
     parser.add_argument("--deadline-floor", type=float, default=0.25,
                         help="remaining-budget floor below which the LMS "
                              "degrades instead of forwarding to tutoring")
+    parser.add_argument("--blob-fetch-timeout", type=float, default=5.0,
+                        help="per-peer cap on blob fetch-on-miss FetchFile "
+                             "RPCs; each attempt also spends the calling "
+                             "request's remaining deadline budget")
+    parser.add_argument("--replicate-timeout", type=float, default=30.0,
+                        help="per-peer cap on post-upload SendFile "
+                             "replication streams")
+    parser.add_argument("--replicate-budget", type=float, default=60.0,
+                        help="overall budget for one upload's replication "
+                             "sweep across all peers; peers it never "
+                             "reaches heal via fetch-on-miss")
     parser.add_argument("--fault-seed", type=int, default=0,
                         help="seed for the /admin/faults chaos injector "
                              "(deterministic fault replay)")
@@ -334,6 +348,9 @@ def main(argv=None) -> None:
             "breaker_half_open": cfg.resilience.breaker_half_open_max,
             "tutoring_timeout": cfg.resilience.tutoring_timeout_s,
             "deadline_floor": cfg.resilience.deadline_floor_s,
+            "blob_fetch_timeout": cfg.resilience.blob_fetch_timeout_s,
+            "replicate_timeout": cfg.resilience.replicate_timeout_s,
+            "replicate_budget": cfg.resilience.replicate_budget_s,
             "fault_seed": cfg.resilience.fault_seed,
         }, argv=argv)
         if not args.no_linearizable_reads:

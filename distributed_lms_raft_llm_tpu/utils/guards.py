@@ -31,7 +31,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
-from typing import Iterator, Optional, Sequence
+from typing import Any, Callable, Iterator, Optional, Sequence
 
 log = logging.getLogger(__name__)
 
@@ -156,12 +156,14 @@ class LoopWatchdog:
 
     def __init__(
         self,
-        metrics=None,
+        metrics: Optional[Any] = None,
         *,
         name: str = "loop",
         warn_above_s: float = 0.25,
         warn_every_s: float = 10.0,
-        clock=time.monotonic,
+        clock: Callable[[], float] = time.monotonic,
+        lag_metric: Optional[str] = None,
+        stalls_metric: Optional[str] = None,
     ):
         self.metrics = metrics
         self.name = name
@@ -171,17 +173,26 @@ class LoopWatchdog:
         self._last_warn = 0.0
         self.max_lag_s = 0.0
         self.stalls = 0
+        # Series names default to `<name>_lag`/`<name>_stalls`; wiring
+        # sites that export to /metrics pin them from the metrics
+        # registry instead (make_tick_watchdog), so the emitted names
+        # stay declared.
+        self.lag_metric = lag_metric or f"{name}_lag"
+        self.stalls_metric = stalls_metric or f"{name}_stalls"
 
     def observe(self, lag_s: float) -> None:
         lag_s = max(0.0, float(lag_s))
         self.max_lag_s = max(self.max_lag_s, lag_s)
         if self.metrics is not None:
-            self.metrics.hist(f"{self.name}_lag").observe(lag_s)
+            # Generic infrastructure: the name is whatever the wiring site
+            # chose (registry constants for the exported loops), so the
+            # static declared-name check happens there, not here.
+            self.metrics.hist(self.lag_metric).observe(lag_s)  # lint: disable=metrics-registry
         if lag_s <= self.warn_above_s:
             return
         self.stalls += 1
         if self.metrics is not None:
-            self.metrics.inc(f"{self.name}_stalls")
+            self.metrics.inc(self.stalls_metric)  # lint: disable=metrics-registry
         now = self._clock()
         if now - self._last_warn >= self.warn_every_s:
             self._last_warn = now
@@ -202,8 +213,8 @@ class LoopWatchdog:
 
 
 def make_tick_watchdog(
-    metrics=None, *, tick_interval: float, name: str = "raft_tick",
-    stall_factor: float = 10.0,
+    metrics: Optional[Any] = None, *, tick_interval: float,
+    name: str = "raft_tick", stall_factor: float = 10.0,
 ) -> Optional[LoopWatchdog]:
     """The Raft wiring: warn when a tick lands `stall_factor` intervals
     late (a 10 ms tick loop warning at 100 ms of lag — late enough to
@@ -211,6 +222,14 @@ def make_tick_watchdog(
     Returns None without metrics so callers can wire unconditionally."""
     if metrics is None:
         return None
+    # Pin the default wiring's series names from the registry so
+    # `raft_tick_lag`/`raft_tick_stalls` stay declared-and-live under the
+    # metrics-registry rule; a custom `name` keeps the derived pair.
+    from . import metrics_registry
+
+    default = name == "raft_tick"
     return LoopWatchdog(
-        metrics, name=name, warn_above_s=tick_interval * stall_factor
+        metrics, name=name, warn_above_s=tick_interval * stall_factor,
+        lag_metric=metrics_registry.RAFT_TICK_LAG if default else None,
+        stalls_metric=metrics_registry.RAFT_TICK_STALLS if default else None,
     )

@@ -1,0 +1,236 @@
+"""The single source of truth for every metric series this repo emits.
+
+Before this module, metric names were string literals scattered across
+`lms/`, `serving/`, `engine/`, and `utils/` — a typo'd name shipped an
+always-zero dashboard panel silently, and nothing said what a series
+meant or whether it was a counter or a gauge. Now every series is
+declared exactly once, with its kind and a help string:
+
+    from ..utils import metrics_registry as metric
+    metrics.inc(metric.TUTORING_DEGRADED)        # or the literal name —
+    metrics.inc("tutoring_degraded")             # lint checks both
+
+The `metrics-registry` lint rule (analysis/rules/metrics_registry.py)
+reads THIS file's declarations as pure AST and then proves, project-wide,
+that every name passed to `Metrics.inc/set_gauge/hist/time` is declared
+here — undeclared literals, typos, duplicates, and undocumented series
+all fail `scripts/lint.py`. Declarations must therefore stay literal
+calls to `counter()`/`gauge()`/`histogram()` at module level (the rule
+enforces that too). The README's metrics table is rendered from here
+(`python scripts/gen_metrics_table.py --write`), so docs cannot drift
+from what servers actually export.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    kind: str
+    help: str
+
+
+_REGISTRY: Dict[str, MetricSpec] = {}
+
+
+def _declare(kind: str, name: str, help: str) -> str:
+    if not _NAME_RE.match(name):
+        raise ValueError(f"metric name {name!r} must match {_NAME_RE.pattern}")
+    if not help.strip():
+        raise ValueError(f"metric {name!r} needs a help string")
+    if name in _REGISTRY:
+        raise ValueError(f"metric {name!r} declared twice")
+    _REGISTRY[name] = MetricSpec(name=name, kind=kind, help=help)
+    return name
+
+
+def counter(name: str, help: str) -> str:
+    """Declare a monotonically increasing count; returns the name."""
+    return _declare(COUNTER, name, help)
+
+
+def gauge(name: str, help: str) -> str:
+    """Declare a last-value reading (a ratio or size, never a latency)."""
+    return _declare(GAUGE, name, help)
+
+
+def histogram(name: str, help: str) -> str:
+    """Declare a latency histogram (seconds; /metrics renders percentiles)."""
+    return _declare(HISTOGRAM, name, help)
+
+
+def all_metrics() -> List[MetricSpec]:
+    """Every declared series, name-sorted (the docs/table order)."""
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def is_declared(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def spec(name: str) -> MetricSpec:
+    return _REGISTRY[name]
+
+
+def render_markdown_table() -> str:
+    """The README metrics catalog, one row per declared series."""
+    lines = [
+        "| name | kind | meaning |",
+        "|---|---|---|",
+    ]
+    for m in all_metrics():
+        lines.append(f"| `{m.name}` | {m.kind} | {m.help} |")
+    return "\n".join(lines)
+
+
+# =========================================================== declarations
+#
+# LMS service (lms/service.py) — the student-facing RPC plane.
+
+REGISTER = counter("register", "Register RPCs received")
+LOGIN = counter("login", "Login RPCs received")
+POST = counter("post", "Post RPCs received (materials, assignments, queries)")
+LLM_REQUESTS = counter(
+    "llm_requests",
+    "GetLLMAnswer RPCs received (LMS leader and tutoring node each count "
+    "their own)",
+)
+GATE_PASS = counter(
+    "gate_pass", "queries the BERT relevance gate accepted"
+)
+GATE_REJECT = counter(
+    "gate_reject", "queries the BERT relevance gate refused"
+)
+LLM_TTFT = histogram(
+    "llm_ttft",
+    "LMS-side student-query latency: gate check + tutoring forward "
+    "(the BASELINE north-star is its p50)",
+)
+TUTORING_DEGRADED = counter(
+    "tutoring_degraded",
+    "queries answered by the degraded instructor-queue fallback",
+)
+TUTORING_FAILURES = counter(
+    "tutoring_failures", "tutoring forwards that failed (RPC error)"
+)
+TUTORING_DUPLICATES = counter(
+    "tutoring_duplicates",
+    "tutoring forwards deliberately delivered twice by the `duplicate` "
+    "chaos fault",
+)
+TUTORING_BUDGET_EXHAUSTED = counter(
+    "tutoring_budget_exhausted",
+    "queries degraded because the client's remaining deadline budget was "
+    "under the floor",
+)
+TUTORING_BREAKER_REJECTIONS = counter(
+    "tutoring_breaker_rejections",
+    "queries degraded because the tutoring circuit breaker was open",
+)
+TUTORING_BREAKER_STATE = gauge(
+    "tutoring_breaker_state",
+    "tutoring circuit breaker state (0 closed / 1 open / 2 half-open)",
+)
+TUTORING_BREAKER_CLOSED = counter(
+    "tutoring_breaker_closed", "breaker transitions into CLOSED"
+)
+TUTORING_BREAKER_OPEN = counter(
+    "tutoring_breaker_open", "breaker transitions into OPEN"
+)
+TUTORING_BREAKER_HALF_OPEN = counter(
+    "tutoring_breaker_half_open", "breaker transitions into HALF_OPEN"
+)
+BLOB_FETCH_ON_MISS = counter(
+    "blob_fetch_on_miss",
+    "blobs healed from a peer after committed metadata referenced a "
+    "locally missing file",
+)
+BLOB_FETCH_BUDGET_EXHAUSTED = counter(
+    "blob_fetch_budget_exhausted",
+    "blob fetch-on-miss sweeps skipped because the request's remaining "
+    "deadline budget was under the floor (metadata-only response instead "
+    "of a doomed peer sweep)",
+)
+REPLICATE_BUDGET_EXHAUSTED = counter(
+    "replicate_budget_exhausted",
+    "file-replication peers skipped because the per-upload replication "
+    "budget ran out mid-sweep (anti-entropy heals them later)",
+)
+
+# Breaker state -> transition counter, used by the LMS breaker observer.
+# Living HERE keeps the mapping inside the declared namespace: the lint
+# rule treats any name expression rooted at this module as declared by
+# construction.
+BREAKER_TRANSITION_COUNTERS: Dict[str, str] = {
+    "closed": TUTORING_BREAKER_CLOSED,
+    "open": TUTORING_BREAKER_OPEN,
+    "half_open": TUTORING_BREAKER_HALF_OPEN,
+}
+
+# Tutoring node (serving/tutoring_server.py + engine/batcher.py).
+
+LLM_UNAUTHORIZED = counter(
+    "llm_unauthorized",
+    "direct-dial queries refused for lacking the LMS leader's HMAC ticket",
+)
+LLM_FAILURES = counter(
+    "llm_failures", "generation failures surfaced to the client"
+)
+ANSWER_LATENCY = histogram(
+    "answer_latency", "full GetLLMAnswer latency on the tutoring node"
+)
+TTFT = histogram(
+    "ttft",
+    "engine-measured time between a request's prefill and its first "
+    "decoded token",
+)
+SHED_EXPIRED = counter(
+    "shed_expired",
+    "requests dropped because their deadline budget expired before "
+    "prefill dispatched",
+)
+SHED_OVERLOAD = counter(
+    "shed_overload",
+    "requests refused at admission because the bounded queue was full "
+    "(RESOURCE_EXHAUSTED on the wire)",
+)
+ENGINE_BATCHES = counter(
+    "engine_batches", "device batches dispatched by the group batcher"
+)
+SPEC_TOKENS_PER_WINDOW = gauge(
+    "spec_tokens_per_window",
+    "speculation effectiveness: mean emitted tokens per verify window "
+    "(1.0 = nothing accepted, ceiling spec_tokens+1)",
+)
+SPEC_ACCEPTED_TOKENS = counter(
+    "spec_accepted_tokens",
+    "tokens speculation produced beyond the guaranteed one per verify "
+    "window",
+)
+
+# Raft runner (utils/guards.py LoopWatchdog wired by lms/node.py).
+
+RAFT_TICK_LAG = histogram(
+    "raft_tick_lag",
+    "how late each Raft tick ran versus its schedule (stalls here are "
+    "the precursor of spurious elections)",
+)
+RAFT_TICK_STALLS = counter(
+    "raft_tick_stalls",
+    "Raft ticks later than 10 heartbeat intervals (each also logged)",
+)
+
+
+if __name__ == "__main__":  # pragma: no cover - convenience
+    print(render_markdown_table())

@@ -28,7 +28,7 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # Metadata key carrying the remaining budget in milliseconds. A *relative*
 # budget (not an absolute timestamp) survives clock skew between hosts; each
@@ -44,7 +44,7 @@ DEADLINE_METADATA_KEY = "x-deadline-budget-ms"
 REQUEST_ID_METADATA_KEY = "x-request-id"
 
 
-def _metadata_value(metadata, key: str) -> Optional[str]:
+def _metadata_value(metadata: Any, key: str) -> Optional[str]:
     """First value for `key` in a gRPC metadata sequence (pairs or a
     mapping — the sync and aio stacks disagree on the shape); None when
     absent. The single normalization point for every header this module
@@ -58,7 +58,7 @@ def _metadata_value(metadata, key: str) -> Optional[str]:
     return None
 
 
-def request_id_from_grpc_context(context) -> Optional[str]:
+def request_id_from_grpc_context(context: Any) -> Optional[str]:
     """The client's logical-request id from metadata; None when absent."""
     try:
         metadata = context.invocation_metadata()
@@ -125,7 +125,7 @@ class Deadline:
 
     @classmethod
     def from_metadata(
-        cls, metadata, *, clock: Callable[[], float] = time.monotonic
+        cls, metadata: Any, *, clock: Callable[[], float] = time.monotonic
     ) -> Optional["Deadline"]:
         """Decode the budget header from a gRPC metadata sequence (pairs or
         a mapping); None when absent or malformed."""
@@ -139,7 +139,7 @@ class Deadline:
 
     @classmethod
     def from_grpc_context(
-        cls, context, *, clock: Callable[[], float] = time.monotonic
+        cls, context: Any, *, clock: Callable[[], float] = time.monotonic
     ) -> Optional["Deadline"]:
         """Recover the caller's budget server-side: the tighter of the
         native gRPC deadline (`context.time_remaining()`, propagated from
@@ -316,7 +316,7 @@ class CircuitBreaker:
         """Numeric encoding for a metrics gauge (0/1/2)."""
         return self._STATE_CODES[self.state]
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> Dict[str, object]:
         with self._lock:
             self._maybe_half_open()
             return {

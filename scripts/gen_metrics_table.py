@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Render the README metrics table from utils/metrics_registry.py.
+
+The registry is the single declaration point for every metric series the
+servers emit (enforced by the `metrics-registry` lint rule); this script
+keeps the README's human-facing catalog generated from it, so the docs
+cannot drift from what /metrics actually exports:
+
+    python scripts/gen_metrics_table.py            # print the table
+    python scripts/gen_metrics_table.py --check    # exit 1 if README drifted
+    python scripts/gen_metrics_table.py --write    # rewrite the README block
+
+The table lives between the `<!-- metrics-table:begin -->` /
+`<!-- metrics-table:end -->` markers in README.md;
+tests/test_lint_clean.py runs the --check logic in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from distributed_lms_raft_llm_tpu.utils import metrics_registry  # noqa: E402
+
+BEGIN = "<!-- metrics-table:begin -->"
+END = "<!-- metrics-table:end -->"
+README = REPO / "README.md"
+
+
+def rendered_block() -> str:
+    return f"{BEGIN}\n{metrics_registry.render_markdown_table()}\n{END}"
+
+
+def current_block(text: str) -> str | None:
+    start = text.find(BEGIN)
+    end = text.find(END)
+    if start == -1 or end == -1 or end < start:
+        return None
+    return text[start : end + len(END)]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true",
+                      help="exit 1 when README's table differs from the "
+                           "registry")
+    mode.add_argument("--write", action="store_true",
+                      help="rewrite README's table block in place")
+    args = parser.parse_args(argv)
+
+    block = rendered_block()
+    if not (args.check or args.write):
+        print(block)
+        return 0
+
+    text = README.read_text()
+    existing = current_block(text)
+    if existing is None:
+        print(f"README.md has no {BEGIN} / {END} markers", file=sys.stderr)
+        return 1
+    if args.check:
+        if existing != block:
+            print("README metrics table is stale; run "
+                  "`python scripts/gen_metrics_table.py --write`",
+                  file=sys.stderr)
+            return 1
+        print("metrics table up to date "
+              f"({len(metrics_registry.all_metrics())} series)")
+        return 0
+    if existing != block:
+        README.write_text(text.replace(existing, block))
+        print("README metrics table rewritten")
+    else:
+        print("README metrics table already up to date")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

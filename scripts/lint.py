@@ -3,20 +3,50 @@
 
     python scripts/lint.py                 # whole tree (package+scripts+tests)
     python scripts/lint.py --json          # machine-readable findings
-    python scripts/lint.py --rule guarded-by engine/  # one rule, one subtree
+    python scripts/lint.py --rules guarded-by,deadline-flow engine/
+    python scripts/lint.py --baseline lint-baseline.json   # fail on NEW only
+    python scripts/lint.py --types         # + the mypy strict-subset gate
     python scripts/lint.py --list-rules    # the catalog
 
-Exit status: 0 when clean, 1 when any unsuppressed finding remains, 2 on
-usage errors. `tests/test_lint_clean.py` runs the same `run_lint()` entry
-point in tier-1, so CI and this CLI can never disagree about "clean".
+Exit status: 0 when clean, 1 when any (non-baselined) finding remains or
+the type gate fails, 2 on usage errors. `tests/test_lint_clean.py` runs
+the same `run_lint()` entry point in tier-1, so CI and this CLI can never
+disagree about "clean".
+
+## JSON schema (stable; additive changes only)
+
+`--json` emits one document:
+
+    {
+      "schema": "dlrl-lint/1",
+      "clean": bool,                  // no live findings (after baseline)
+      "rules": [str, ...],            // rule names that ran
+      "findings": [                   // live findings, sorted
+        {"rule": str, "path": str, "line": int, "message": str}, ...
+      ],
+      "baselined": int,               // findings suppressed by --baseline
+      "stale_baseline": [             // baseline entries nothing matched
+        {"rule": str, "path": str, "message": str}, ...
+      ]
+    }
+
+## Baselines (incremental adoption)
+
+`--write-baseline f.json` records today's findings; `--baseline f.json`
+then suppresses exactly those (matched on rule+path+message — line
+numbers drift with unrelated edits) so a tree that predates a rule can
+gate on NEW findings immediately and burn the baseline down over time.
+Stale entries are reported so a shrinking baseline stays honest.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
+from typing import Dict, List, Tuple
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
@@ -26,16 +56,82 @@ from distributed_lms_raft_llm_tpu.analysis import (  # noqa: E402
     run_lint,
 )
 
+# The mypy strict-subset gate (--types): these modules carry full
+# annotations; pyproject.toml holds the per-module strictness flags.
+TYPED_SUBSET = [
+    "distributed_lms_raft_llm_tpu/raft/core.py",
+    "distributed_lms_raft_llm_tpu/utils/resilience.py",
+    "distributed_lms_raft_llm_tpu/utils/guards.py",
+    "distributed_lms_raft_llm_tpu/utils/metrics_registry.py",
+    "distributed_lms_raft_llm_tpu/analysis",
+]
+
+_BaselineKey = Tuple[str, str, str]
+
+
+def _baseline_key(f: Dict[str, object]) -> _BaselineKey:
+    return (str(f["rule"]), str(f["path"]), str(f["message"]))
+
+
+def _load_baseline(path: Path) -> List[_BaselineKey]:
+    """Accepts a --write-baseline file or any --json output document."""
+    doc = json.loads(path.read_text())
+    entries = doc["findings"] if isinstance(doc, dict) else doc
+    return [_baseline_key(e) for e in entries]
+
+
+def run_type_gate() -> int:
+    """The mypy strict-on-subset gate; returns an exit code.
+
+    The container may not ship mypy (the runtime stack doesn't need it);
+    in that case the gate reports itself skipped and passes — the lint
+    rules still run everywhere, and CI images with mypy enforce types.
+    """
+    try:
+        import mypy  # noqa: F401
+    except ImportError:
+        print("types: mypy not installed; skipping the type gate "
+              "(pip install mypy to enable)", file=sys.stderr)
+        return 0
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--config-file", "pyproject.toml",
+         *TYPED_SUBSET],
+        cwd=str(REPO), capture_output=True, text=True,
+    )
+    out = (proc.stdout or "") + (proc.stderr or "")
+    if proc.returncode != 0:
+        sys.stderr.write(out)
+        print("types: FAILED", file=sys.stderr)
+        return 1
+    print(f"types ok ({len(TYPED_SUBSET)} targets)")
+    return 0
+
 
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
     parser.add_argument("paths", nargs="*",
                         help="files/directories to lint (default: the "
                              "package, scripts/ and tests/)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="emit findings as a JSON document")
-    parser.add_argument("--rule", action="append", default=None,
-                        help="run only this rule (repeatable)")
+                        help="emit the dlrl-lint/1 JSON document")
+    parser.add_argument("--rule", "--rules", action="append", default=None,
+                        dest="rules", metavar="RULES",
+                        help="run only these rules (comma-separated; "
+                             "repeatable)")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="JSON baseline of known findings to suppress; "
+                             "only NEW findings fail the run")
+    parser.add_argument("--write-baseline", type=Path, default=None,
+                        metavar="PATH",
+                        help="write the current findings as a baseline "
+                             "file and exit 0")
+    parser.add_argument("--types", action="store_true",
+                        help="also run the mypy strict-subset gate "
+                             "(skipped with a note when mypy is not "
+                             "installed)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     args = parser.parse_args(argv)
@@ -45,23 +141,63 @@ def main(argv=None) -> int:
         for rule in sorted(rules, key=lambda r: r.name):
             print(f"{rule.name}: {rule.description}")
         return 0
-    if args.rule:
+    if args.rules:
+        wanted = {
+            name.strip()
+            for chunk in args.rules
+            for name in chunk.split(",")
+            if name.strip()
+        }
         known = {r.name for r in rules}
-        unknown = set(args.rule) - known
+        unknown = wanted - known
         if unknown:
             print(f"unknown rule(s): {sorted(unknown)} "
                   f"(known: {sorted(known)})", file=sys.stderr)
             return 2
-        rules = [r for r in rules if r.name in set(args.rule)]
+        rules = [r for r in rules if r.name in wanted]
 
     paths = [Path(p) for p in args.paths] or None
     findings = run_lint(paths=paths, rules=rules, root=REPO)
 
+    if args.write_baseline is not None:
+        args.write_baseline.write_text(json.dumps({
+            "schema": "dlrl-lint/1",
+            "findings": [f.to_json() for f in findings],
+        }, indent=2) + "\n")
+        print(f"wrote {len(findings)} finding(s) to {args.write_baseline}")
+        return 0
+
+    baselined = 0
+    stale: List[_BaselineKey] = []
+    if args.baseline is not None:
+        try:
+            known_keys = set(_load_baseline(args.baseline))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        live = []
+        matched = set()
+        for f in findings:
+            key = _baseline_key(f.to_json())
+            if key in known_keys:
+                baselined += 1
+                matched.add(key)
+            else:
+                live.append(f)
+        stale = sorted(known_keys - matched)
+        findings = live
+
     if args.as_json:
         print(json.dumps({
+            "schema": "dlrl-lint/1",
             "clean": not findings,
             "rules": sorted(r.name for r in rules),
             "findings": [f.to_json() for f in findings],
+            "baselined": baselined,
+            "stale_baseline": [
+                {"rule": r, "path": p, "message": m} for r, p, m in stale
+            ],
         }, indent=2))
     else:
         for f in findings:
@@ -72,8 +208,17 @@ def main(argv=None) -> int:
                   "intentional cases with `# lint: disable=<rule>` "
                   "(see README)", file=sys.stderr)
         else:
-            print(f"lint ok ({len(rules)} rules)")
-    return 1 if findings else 0
+            note = f" ({baselined} baselined)" if baselined else ""
+            print(f"lint ok ({len(rules)} rules){note}")
+        if stale:
+            print(f"note: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) — "
+                  "regenerate with --write-baseline", file=sys.stderr)
+
+    rc = 1 if findings else 0
+    if args.types and run_type_gate() != 0:
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

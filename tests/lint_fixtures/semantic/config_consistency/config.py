@@ -1,0 +1,23 @@
+"""config-consistency fixtures: the declarative config module."""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 50051
+    dead_knob: float = 1.0  # EXPECT: config-consistency
+    sanctioned_future_knob: int = 0  # lint: disable=config-consistency
+    nodes: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class LimitsConfig:
+    max_queue: int = 64
+
+
+@dataclasses.dataclass
+class AppConfig:
+    server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+    limits: LimitsConfig = dataclasses.field(default_factory=LimitsConfig)

@@ -1,0 +1,7 @@
+"""config-consistency fixtures: the code that consumes the knobs."""
+
+
+def boot(cfg):
+    bind = f"{cfg.server.host}:{cfg.server.port}"
+    peers = dict(cfg.server.nodes)
+    return bind, peers, cfg.limits.max_queue

@@ -1,0 +1,60 @@
+"""deadline-flow fixtures: handlers, helpers, callbacks, stub egress."""
+
+import asyncio
+
+
+class FooServicer(rpc.FooServicer):  # noqa: F821 - fixture, never imported
+    async def GetThing(self, request, context):
+        stub = self._stub()
+        return await stub.FetchThing(request, timeout=5)  # EXPECT: deadline-flow
+
+    async def HelperPath(self, request, context):
+        return await self._forward(request)
+
+    async def _forward(self, request):
+        # Reachable through the handler's call, one hop deep.
+        return await self.stub.SendThing(request, timeout=30)  # EXPECT: deadline-flow
+
+    async def GoodDerived(self, request, context):
+        deadline = Deadline.from_grpc_context(context)  # noqa: F821
+        # Derived from the propagated budget: the fix shape, never flagged.
+        return await self.stub.FetchThing(
+            request, timeout=deadline.timeout(cap=5.0)
+        )
+
+    async def GoodCapped(self, request, context):
+        budget = context.time_remaining()
+        return await self.stub.FetchThing(
+            request, timeout=max(0.001, budget - 0.25)
+        )
+
+    async def SnakeCaseHelpersAreNotEgress(self, request, context):
+        # asyncio.wait_for is not a gRPC stub call (snake_case).
+        return await asyncio.wait_for(self.queue.get(), timeout=5)
+
+    async def Sanctioned(self, request, context):
+        # A deliberate fixed-latency probe, visibly suppressed.
+        return await self.stub.Probe(request, timeout=1)  # lint: disable=deadline-flow
+
+
+class Node:
+    def __init__(self, raft):
+        # Address-taken: the callback runs on the serving loop in response
+        # to committed RPCs, so everything it calls is handler-reachable.
+        raft.apply_cb = self._apply
+
+    def _apply(self, index, entry):
+        asyncio.ensure_future(replicate_to_peers(self.addresses, entry))
+
+
+async def replicate_to_peers(addresses, entry):
+    for addr in addresses:
+        async with channel(addr) as ch:  # noqa: F821
+            stub = make_stub(ch)  # noqa: F821
+            await stub.SendFile(entry, timeout=30)  # EXPECT: deadline-flow
+
+
+async def unreferenced_helper(stub, request):
+    # Dead code: no handler reaches it, no reference escapes — a literal
+    # timeout here is someone else's problem, not this rule's.
+    return await stub.SendAll(request, timeout=30)

@@ -1,0 +1,50 @@
+"""guarded-by-flow fixtures: loop-confined state reached from executors."""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self, loop):
+        self._loop = loop
+        self._futures = {}  # guarded-by: event-loop
+        self._done = []     # guarded-by: event-loop
+
+    def _reap(self):
+        # Mutation looks loop-confined, but run() hands this method's
+        # REFERENCE to an executor — the lexical rule cannot see that.
+        self._futures.clear()  # EXPECT: guarded-by-flow
+
+    def _outer(self):
+        self._reap_helper()
+
+    def _reap_helper(self):
+        # Two hops from the executor: seeded via _outer, closed over the
+        # call graph.
+        self._done.append(1)  # EXPECT: guarded-by-flow
+
+    def on_loop(self, rid, fut):
+        # Only ever called from coroutines on the loop: never flagged.
+        self._futures[rid] = fut
+
+    async def run(self):
+        await self._loop.run_in_executor(None, self._reap)
+        await self._loop.run_in_executor(None, self._outer)
+
+    def _sanctioned(self):
+        # Deliberate (e.g. a shutdown path with the loop stopped),
+        # visibly suppressed.
+        self._futures.clear()  # lint: disable=guarded-by-flow
+
+    async def drain_on_shutdown(self):
+        await self._loop.run_in_executor(None, self._sanctioned)
+
+
+def _background_sync():
+    return 42  # touches no guarded state: seeded, but nothing to flag
+
+
+def spawn_thread():
+    # Thread(target=...) keyword references seed thread context too.
+    t = threading.Thread(target=_background_sync)
+    t.start()
+    return t

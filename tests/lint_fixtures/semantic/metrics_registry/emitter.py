@@ -1,0 +1,50 @@
+"""metrics-registry fixtures: emission sites, good and bad."""
+
+from . import metrics_registry
+
+
+class Server:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def declared_literal(self):
+        self.metrics.inc("good_series")  # fine: declared
+
+    def declared_gauge(self):
+        self.metrics.set_gauge("state_series", 1.0)  # fine: declared
+
+    def typo(self):
+        self.metrics.inc("goood_series")  # EXPECT: metrics-registry
+
+    def undeclared_hist(self):
+        with self.metrics.time("mystery_latency"):  # EXPECT: metrics-registry
+            pass
+
+    def branch_literals(self, ok):
+        # IfExp of literals: both branches are checked individually.
+        self.metrics.inc("good_series" if ok else "state_series")  # fine
+
+    def dynamic(self, which):
+        self.metrics.inc("prefix_" + which)  # EXPECT: metrics-registry
+
+    def registry_rooted(self, state):
+        # Rooted at the registry module: declared by construction.
+        self.metrics.inc(metrics_registry.FAMILY[state])
+
+    def registry_constant(self):
+        self.metrics.inc(metrics_registry.GOOD)
+
+    def sanctioned_dynamic(self, name):
+        self.metrics.inc("scratch_" + name)  # lint: disable=metrics-registry
+
+    def _inc(self, name):
+        # Forwarding seam: the parameter flows straight into the
+        # primitive, so CALL SITES are checked, not this line.
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    def via_wrapper_ok(self):
+        self._inc("good_series")  # fine: declared
+
+    def via_wrapper_typo(self):
+        self._inc("bad_series")  # EXPECT: metrics-registry

@@ -1,0 +1,24 @@
+"""metrics-registry fixtures: the declaration point (well- and ill-formed)."""
+
+
+def counter(name, help):
+    return name
+
+
+def gauge(name, help):
+    return name
+
+
+GOOD = counter("good_series", "a documented counter")
+STATE = gauge("state_series", "a documented gauge")
+UNUSED = counter("unused_series", "declared but nothing emits it")  # EXPECT: metrics-registry
+DUPLICATE = counter("good_series", "second declaration of the same name")  # EXPECT: metrics-registry
+NON_LITERAL = counter(SOME_VAR, "name the linter cannot read")  # noqa: F821  # EXPECT: metrics-registry
+NO_HELP = counter("undocumented_series", "")  # EXPECT: metrics-registry
+
+# Grouped names stay declared-by-construction when emitted through the
+# mapping (see emitter.registry_rooted).
+FAMILY = {
+    "ok": GOOD,
+    "literal": "state_series",
+}

@@ -1,0 +1,196 @@
+"""The request-path file RPCs spend deadline budget instead of wall-clock
+constants (PR-4 sweep for the deadline-flow rule's two real findings).
+
+Before: blob fetch-on-miss dialed every peer with `timeout=5` and the
+upload replication sweep gave each peer `timeout=30` — a client whose
+budget had already expired could still pin the node for (peers × cap)
+seconds. Now both derive per-attempt timeouts from the live budget with
+`[resilience]` caps, and an expired budget fails fast (counted, not
+dialed). These tests pin the fail-fast property with wall-clock bounds
+far below the old fixed timeouts.
+"""
+
+import asyncio
+import time
+import types
+
+import pytest
+
+from distributed_lms_raft_llm_tpu.lms import service as service_mod
+from distributed_lms_raft_llm_tpu.lms.persistence import BlobStore
+from distributed_lms_raft_llm_tpu.lms.service import (
+    LMSServicer,
+    replicate_file_to_peers,
+)
+from distributed_lms_raft_llm_tpu.lms.state import LMSState
+from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+from distributed_lms_raft_llm_tpu.utils.resilience import Deadline
+
+
+def _servicer(tmp_path, metrics, blob_fetch_timeout_s=5.0):
+    return LMSServicer(
+        types.SimpleNamespace(leader_id=1),
+        LMSState(),
+        BlobStore(str(tmp_path / "blobs")),
+        metrics=metrics,
+        peer_addresses={1: "127.0.0.1:1", 2: "127.0.0.1:2"},
+        self_id=3,
+        blob_fetch_timeout_s=blob_fetch_timeout_s,
+    )
+
+
+def test_blob_fetch_expired_budget_fails_fast(tmp_path, monkeypatch):
+    """An expired client budget returns metadata-only WITHOUT dialing any
+    peer (the old code spent up to 5 s per peer on a dead request)."""
+    metrics = Metrics()
+    servicer = _servicer(tmp_path, metrics)
+
+    def no_dial(*a, **k):  # the whole point: the sweep never starts
+        raise AssertionError("dialed a peer with an expired budget")
+
+    monkeypatch.setattr(service_mod.grpc.aio, "insecure_channel", no_dial)
+    t0 = time.monotonic()
+    content = asyncio.run(
+        servicer._blob("materials/x.pdf", deadline=Deadline.after(0.0))
+    )
+    assert content == b""
+    assert time.monotonic() - t0 < 1.0
+    assert metrics.snapshot()["counters"]["blob_fetch_budget_exhausted"] == 1
+
+
+def test_blob_fetch_timeout_derived_from_live_budget(tmp_path, monkeypatch):
+    """With budget below the cap, each per-peer FetchFile timeout is the
+    remaining budget, not the 5 s cap."""
+    metrics = Metrics()
+    servicer = _servicer(tmp_path, metrics)
+    captured = []
+
+    class FakeChannel:
+        async def __aenter__(self):
+            return self
+
+        async def __aexit__(self, *exc):
+            return False
+
+    class FakeStub:
+        def __init__(self, channel):
+            pass
+
+        async def FetchFile(self, request, timeout=None):
+            captured.append(timeout)
+            return types.SimpleNamespace(found=False, content=b"")
+
+    monkeypatch.setattr(
+        service_mod.grpc.aio, "insecure_channel",
+        lambda *a, **k: FakeChannel(),
+    )
+    monkeypatch.setattr(
+        service_mod.rpc, "FileTransferServiceStub", FakeStub
+    )
+    content = asyncio.run(
+        servicer._blob("materials/x.pdf", deadline=Deadline.after(0.8))
+    )
+    assert content == b""
+    assert captured, "with budget in hand the sweep should try peers"
+    assert all(0.0 < t <= 0.8 for t in captured), captured
+    # Unlimited-budget callers still get the configured cap.
+    captured.clear()
+    servicer._blob_missing.clear()
+    asyncio.run(servicer._blob("materials/x.pdf", deadline=None))
+    assert captured and all(t == 5.0 for t in captured), captured
+
+
+def test_replicate_expired_budget_fails_fast(tmp_path):
+    """An exhausted replication budget skips every remaining peer
+    immediately instead of spending timeout=30 each (the :741 finding)."""
+    blobs = BlobStore(str(tmp_path / "blobs"))
+    blobs.put("materials/a.pdf", b"x" * 1024)
+    metrics = Metrics()
+    t0 = time.monotonic()
+    results = asyncio.run(replicate_file_to_peers(
+        {1: "127.0.0.1:1", 2: "127.0.0.1:2"}, 0, blobs, "materials/a.pdf",
+        per_peer_timeout_s=30.0,
+        deadline=Deadline.after(0.0),
+        metrics=metrics,
+    ))
+    assert time.monotonic() - t0 < 1.0, "must not wait out per-peer caps"
+    assert results == {
+        1: "skipped: replication budget exhausted",
+        2: "skipped: replication budget exhausted",
+    }
+    assert metrics.snapshot()["counters"]["replicate_budget_exhausted"] == 2
+
+
+def test_replicate_live_budget_caps_per_peer_timeout(tmp_path):
+    """Alive-but-small budget: attempts happen, each capped by the
+    remaining budget (unroutable peers fail fast with UNAVAILABLE)."""
+    blobs = BlobStore(str(tmp_path / "blobs"))
+    blobs.put("materials/a.pdf", b"y")
+    t0 = time.monotonic()
+    results = asyncio.run(replicate_file_to_peers(
+        {1: "127.0.0.1:1"}, 0, blobs, "materials/a.pdf",
+        per_peer_timeout_s=30.0,
+        deadline=Deadline.after(1.5),
+    ))
+    # Whatever the failure mode (refused fast or deadline), the sweep is
+    # bounded by the budget, not the 30 s cap.
+    assert time.monotonic() - t0 < 10.0
+    assert list(results) == [1]
+    assert results[1].startswith("error:") or "skipped" in results[1]
+
+
+def test_missing_blob_returns_empty_without_deadline(tmp_path):
+    """Source-missing blobs short-circuit before any peer logic."""
+    blobs = BlobStore(str(tmp_path / "blobs"))
+    results = asyncio.run(replicate_file_to_peers(
+        {1: "127.0.0.1:1"}, 0, blobs, "materials/none.pdf",
+        deadline=Deadline.after(0.0),
+    ))
+    assert results == {}
+
+
+@pytest.mark.parametrize("budget_s,cap,expect_floor", [
+    (0.1, 5.0, True),    # under the 0.25 floor: degrade, don't dial
+    (3.0, 5.0, False),   # healthy: dial with ~3 s
+    # A cap tighter than the floor shortens attempts but must NOT
+    # disable the sweep while real budget remains (the floor compares
+    # against the remaining budget, not the cap-limited timeout).
+    (100.0, 0.2, False),
+])
+def test_blob_fetch_floor_behavior(tmp_path, monkeypatch, budget_s, cap,
+                                   expect_floor):
+    metrics = Metrics()
+    servicer = _servicer(tmp_path, metrics, blob_fetch_timeout_s=cap)
+    dialed = []
+
+    class FakeChannel:
+        async def __aenter__(self):
+            return self
+
+        async def __aexit__(self, *exc):
+            return False
+
+    class FakeStub:
+        def __init__(self, channel):
+            pass
+
+        async def FetchFile(self, request, timeout=None):
+            dialed.append(timeout)
+            return types.SimpleNamespace(found=False, content=b"")
+
+    monkeypatch.setattr(
+        service_mod.grpc.aio, "insecure_channel",
+        lambda *a, **k: FakeChannel(),
+    )
+    monkeypatch.setattr(service_mod.rpc, "FileTransferServiceStub", FakeStub)
+    asyncio.run(
+        servicer._blob("materials/x.pdf", deadline=Deadline.after(budget_s))
+    )
+    counters = metrics.snapshot()["counters"]
+    if expect_floor:
+        assert not dialed
+        assert counters.get("blob_fetch_budget_exhausted") == 1
+    else:
+        assert dialed
+        assert all(t <= cap for t in dialed), dialed
+        assert "blob_fetch_budget_exhausted" not in counters

@@ -1,13 +1,33 @@
 """Tier-1 gate: the tree is lint-clean under the full rule set.
 
 The `tests/test_marker_audit.py` pattern generalized: every rule in the
-catalog runs over the package, scripts, and tests, and any unsuppressed
-finding fails the suite — so the bug classes the rules encode (the PR-2
-silent-recompile spelling bug above all) cannot be reintroduced without a
-visible, attributable `# lint: disable=` comment in the diff.
+catalog — per-file lexical AND whole-repo semantic (call graph, metrics
+registry, config consistency) — runs over the package, scripts, and
+tests, and any unsuppressed finding fails the suite; the bug classes the
+rules encode cannot be reintroduced without a visible, attributable
+`# lint: disable=` comment in the diff. Reversion pins below prove the
+expensive acceptance cases stay caught: un-deriving either request-path
+RPC timeout, or emitting an unregistered metric name, fails lint again.
 """
 
+from pathlib import Path
+
 from distributed_lms_raft_llm_tpu.analysis import all_rules, run_lint
+from distributed_lms_raft_llm_tpu.analysis.core import (
+    iter_sources,
+    repo_root,
+)
+from distributed_lms_raft_llm_tpu.analysis.project import Project
+from distributed_lms_raft_llm_tpu.analysis.rules.deadline_flow import (
+    DeadlineFlowRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.metrics_registry import (
+    MetricsRegistryRule,
+)
+from distributed_lms_raft_llm_tpu.utils import metrics_registry
+
+REPO = Path(__file__).resolve().parent.parent
+SERVICE = "distributed_lms_raft_llm_tpu/lms/service.py"
 
 
 def test_tree_is_lint_clean():
@@ -34,5 +54,90 @@ def test_rule_set_covers_the_demonstrated_bug_classes():
         "guarded-by",                # lock-guarded state (PR-1 review class)
         "tracer-hygiene",            # python control flow on tracers
         "slow-marker",               # tier-1 timeout protection
+        "deadline-flow",             # PR-4: budget-dropping RPC timeouts
+        "metrics-registry",          # PR-4: typo'd/undocumented series
+        "config-consistency",        # PR-4: dead knobs, typo'd TOML keys
+        "guarded-by-flow",           # PR-4: executor escape via call graph
     ):
         assert required in names, f"rule {required} missing from the catalog"
+
+
+# ------------------------------------------------------- reversion pins
+
+
+def _project_with_patched_service(old: str, new: str) -> Project:
+    """The real repo tree, with one textual edit to lms/service.py —
+    exactly what `git revert` of a sweep fix would produce."""
+    root = repo_root()
+    sources = iter_sources(None, root=root)
+    patched = []
+    for src in sources:
+        if src.rel == SERVICE:
+            text = src.text
+            assert old in text, f"pin is stale: {old!r} not in {SERVICE}"
+            src = type(src)(src.path, root=root,
+                            text=text.replace(old, new, 1))
+        patched.append(src)
+    return Project(patched, root=root)
+
+
+def test_reverting_blob_fetch_timeout_fix_fails_lint():
+    project = _project_with_patched_service(
+        "timeout=attempt_timeout,", "timeout=5,"
+    )
+    findings = [
+        f for f in DeadlineFlowRule().check_project(project)
+        if f.path == SERVICE
+    ]
+    assert findings, "a re-hardcoded FetchFile timeout must fail deadline-flow"
+
+
+def test_reverting_replicate_timeout_fix_fails_lint():
+    project = _project_with_patched_service(
+        "timeout=attempt_timeout)", "timeout=30)"
+    )
+    findings = [
+        f for f in DeadlineFlowRule().check_project(project)
+        if f.path == SERVICE
+    ]
+    assert findings, "a re-hardcoded SendFile timeout must fail deadline-flow"
+
+
+def test_unregistered_metric_name_fails_lint():
+    project = _project_with_patched_service(
+        '"tutoring_degraded"', '"tutoring_degarded"'
+    )
+    findings = [
+        f for f in MetricsRegistryRule().check_project(project)
+        if f.path == SERVICE and "tutoring_degarded" in f.message
+    ]
+    assert findings, "a typo'd metric name must fail metrics-registry"
+
+
+# --------------------------------------------------- registry <-> README
+
+
+def test_metrics_registry_declarations_are_live():
+    specs = metrics_registry.all_metrics()
+    assert len(specs) >= 25
+    kinds = {s.kind for s in specs}
+    assert kinds <= {"counter", "gauge", "histogram"}
+    # The names the rest of the suite depends on stay declared.
+    for name in ("llm_ttft", "ttft", "shed_expired", "shed_overload",
+                 "spec_tokens_per_window", "raft_tick_lag",
+                 "blob_fetch_budget_exhausted", "replicate_budget_exhausted"):
+        assert metrics_registry.is_declared(name), name
+
+
+def test_readme_metrics_table_matches_registry():
+    """README's metrics catalog is generated from the registry
+    (scripts/gen_metrics_table.py --write); drift fails tier-1."""
+    text = (REPO / "README.md").read_text()
+    begin, end = "<!-- metrics-table:begin -->", "<!-- metrics-table:end -->"
+    assert begin in text and end in text, "README lost the table markers"
+    block = text[text.index(begin): text.index(end) + len(end)]
+    want = f"{begin}\n{metrics_registry.render_markdown_table()}\n{end}"
+    assert block == want, (
+        "README metrics table is stale; run "
+        "`python scripts/gen_metrics_table.py --write`"
+    )

@@ -12,6 +12,7 @@ Rules are exercised via `rule.check(Source)` directly — path scoping
 fixtures don't need to masquerade as engine files or collectible tests.
 """
 
+import json
 import re
 import subprocess
 import sys
@@ -19,8 +20,21 @@ from pathlib import Path
 
 from distributed_lms_raft_llm_tpu.analysis import all_rules
 from distributed_lms_raft_llm_tpu.analysis.core import Source
+from distributed_lms_raft_llm_tpu.analysis.project import Project
 from distributed_lms_raft_llm_tpu.analysis.rules.async_blocking import (
     BlockingInAsyncRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.config_consistency import (
+    ConfigConsistencyRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.deadline_flow import (
+    DeadlineFlowRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.guarded_by_flow import (
+    GuardedByFlowRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.metrics_registry import (
+    MetricsRegistryRule,
 )
 from distributed_lms_raft_llm_tpu.analysis.rules.canonical_pspec import (
     CanonicalPSpecRule,
@@ -97,6 +111,99 @@ def test_tracer_hygiene_fixture():
 
 def test_slow_marker_fixture():
     run_rule(SlowMarkerRule(), "markers.py")
+
+
+# ---------------------------------------------------- semantic (project)
+
+
+SEMANTIC = FIXTURES / "semantic"
+
+
+def run_project_rule(rule, case: str):
+    """Run a ProjectRule over the mini-project at semantic/<case>/ and
+    compare flagged lines per file to `# EXPECT: <rule>` markers in every
+    .py AND .toml file of the case (suppressions applied, as run_lint
+    does)."""
+    case_dir = SEMANTIC / case
+    sources = [
+        Source(path, root=case_dir)
+        for path in sorted(case_dir.rglob("*.py"))
+    ]
+    project = Project(sources, root=case_dir)
+    by_rel = {src.rel: src for src in sources}
+    flagged = {}
+    for f in rule.check_project(project):
+        src = by_rel.get(f.path)
+        if src is not None and src.suppressed(f.rule, f.line):
+            continue
+        flagged.setdefault(f.path, set()).add(f.line)
+    expected = {}
+    for path in sorted(case_dir.rglob("*")):
+        if path.suffix not in (".py", ".toml"):
+            continue
+        rel = path.relative_to(case_dir).as_posix()
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            m = _EXPECT_RE.search(line)
+            if m and rule.name in {n.strip() for n in m.group(1).split(",")}:
+                expected.setdefault(rel, set()).add(lineno)
+    assert flagged == expected, (
+        f"{rule.name} on semantic/{case}: flagged "
+        f"{ {k: sorted(v) for k, v in flagged.items()} } but expected "
+        f"{ {k: sorted(v) for k, v in expected.items()} }"
+    )
+    return project
+
+
+def test_deadline_flow_fixture():
+    # watch everything in the mini-project (the real default scopes to
+    # the lms/ + serving/ request-path modules).
+    run_project_rule(DeadlineFlowRule(watch_prefixes=("",)), "deadline_flow")
+
+
+def test_metrics_registry_fixture():
+    run_project_rule(
+        MetricsRegistryRule(watch_prefixes=("",), exclude_rels=()),
+        "metrics_registry",
+    )
+
+
+def test_config_consistency_fixture():
+    run_project_rule(ConfigConsistencyRule(), "config_consistency")
+
+
+def test_guarded_by_flow_fixture():
+    run_project_rule(GuardedByFlowRule(), "guarded_by_flow")
+
+
+def test_same_line_emissions_are_all_checked(tmp_path):
+    """Two metric emissions sharing one source line must BOTH be checked
+    (the nested-def dedup collapses on (line, col), never on line alone)."""
+    (tmp_path / "metrics_registry.py").write_text(
+        "def counter(name, help):\n    return name\n"
+        'GOOD = counter("good_series", "doc")\n'
+    )
+    (tmp_path / "emit.py").write_text(
+        "class S:\n"
+        "    def go(self, metrics):\n"
+        '        metrics.inc("good_series"); metrics.inc("bogus_series")\n'
+    )
+    sources = [Source(p, root=tmp_path)
+               for p in sorted(tmp_path.glob("*.py"))]
+    project = Project(sources, root=tmp_path)
+    rule = MetricsRegistryRule(watch_prefixes=("",), exclude_rels=())
+    findings = [f for f in rule.check_project(project)
+                if "bogus_series" in f.message]
+    assert len(findings) == 1, [f.format() for f in
+                                rule.check_project(project)]
+
+
+def test_deadline_flow_default_scope_is_request_path():
+    """The registered instance watches lms/ + serving/, not raft/ (whose
+    protocol timeouts are consensus-liveness knobs, not client budgets)."""
+    rule = next(r for r in all_rules() if r.name == "deadline-flow")
+    assert any(p.endswith("/lms/") for p in rule.watch_prefixes)
+    assert any(p.endswith("/serving/") for p in rule.watch_prefixes)
+    assert not any(p.endswith("/raft/") for p in rule.watch_prefixes)
 
 
 # ------------------------------------------------------------- framework
@@ -192,3 +299,57 @@ def test_cli_json_and_exit_codes(tmp_path):
     )
     assert failing.returncode == 1
     assert "canonical-pspec" in failing.stderr
+
+
+def test_cli_rules_selection_and_baseline(tmp_path):
+    """--rules takes comma lists; --baseline suppresses recorded findings
+    and fails only on NEW ones (the incremental-adoption workflow)."""
+    lint = str(REPO / "scripts" / "lint.py")
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        "A = P(None, None)\n"
+    )
+
+    unknown = subprocess.run(
+        [sys.executable, lint, "--rules", "canonical-pspec,nope"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert unknown.returncode == 2
+    assert "unknown rule" in unknown.stderr
+
+    baseline = tmp_path / "baseline.json"
+    wrote = subprocess.run(
+        [sys.executable, lint, "--rules", "canonical-pspec",
+         "--write-baseline", str(baseline), str(bad)],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    doc = json.loads(baseline.read_text())
+    assert doc["schema"] == "dlrl-lint/1"
+    assert len(doc["findings"]) == 1
+
+    # Same tree + baseline: clean.
+    clean = subprocess.run(
+        [sys.executable, lint, "--rules", "canonical-pspec",
+         "--baseline", str(baseline), "--json", str(bad)],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    out = json.loads(clean.stdout)
+    assert out["clean"] and out["baselined"] == 1
+
+    # A NEW finding still fails; fixing the old one reports it stale.
+    bad.write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        "B = P('x', None)\n"
+    )
+    fresh = subprocess.run(
+        [sys.executable, lint, "--rules", "canonical-pspec",
+         "--baseline", str(baseline), "--json", str(bad)],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert fresh.returncode == 1
+    out = json.loads(fresh.stdout)
+    assert not out["clean"] and out["baselined"] == 0
+    assert len(out["stale_baseline"]) == 1

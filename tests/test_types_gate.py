@@ -1,0 +1,68 @@
+"""The mypy strict-subset gate wired into scripts/lint.py --types.
+
+The runtime container intentionally ships without mypy (the serving stack
+does not need it), so the gate must degrade to an explicit skip there —
+and actually enforce when mypy is present (CI images / dev machines).
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _lint_module():
+    spec = importlib.util.spec_from_file_location(
+        "dlrl_lint_cli", REPO / "scripts" / "lint.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_typed_subset_targets_exist():
+    lint = _lint_module()
+    for target in lint.TYPED_SUBSET:
+        assert (REPO / target).exists(), target
+    # The ISSUE's contract: these four surfaces are type-gated.
+    joined = " ".join(lint.TYPED_SUBSET)
+    for needle in ("raft/core.py", "utils/resilience.py",
+                   "utils/guards.py", "analysis"):
+        assert needle in joined, needle
+
+
+def test_type_gate_skips_cleanly_without_mypy(capsys):
+    lint = _lint_module()
+    have_mypy = importlib.util.find_spec("mypy") is not None
+    rc = lint.run_type_gate()
+    captured = capsys.readouterr()
+    if have_mypy:
+        # With mypy installed the gate must actually pass on the
+        # annotated subset (this is the enforcing path on CI images).
+        assert rc == 0, captured.out + captured.err
+        assert "types ok" in captured.out
+    else:
+        assert rc == 0
+        assert "skipping the type gate" in captured.err
+
+
+def test_mypy_config_present():
+    text = (REPO / "pyproject.toml").read_text()
+    assert "[tool.mypy]" in text
+    assert "disallow_untyped_defs" in text
+
+
+@pytest.mark.skipif(importlib.util.find_spec("mypy") is None,
+                    reason="mypy not installed in this image")
+def test_type_gate_enforces_with_mypy():
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint.py"), "--types",
+         str(REPO / "scripts" / "lint.py")],
+        capture_output=True, text=True, cwd=str(REPO), timeout=300,
+    )
+    assert "types" in proc.stdout + proc.stderr
