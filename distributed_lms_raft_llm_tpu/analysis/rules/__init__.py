@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     canonical_pspec,
     config_consistency,
     deadline_flow,
+    durable_rename,
     guarded_by,
     guarded_by_flow,
     host_sync,

@@ -1,0 +1,125 @@
+"""durable-rename: atomic-rename writes in storage modules must be durable.
+
+The bug class (ALICE, OSDI '14): `os.replace(tmp, final)` makes the *name
+swap* atomic, but nothing orders the tmp file's DATA ahead of the rename —
+after a crash the durable directory entry can point at an empty or partial
+file (this repo's instance: an uploaded PDF committed by `_BlobWriter`
+without an fsync, lms/persistence.py pre-PR-5). And the rename itself is
+only durable once the parent DIRECTORY is fsynced.
+
+So, in the storage modules this rule scopes to, every rename through
+`os.replace`/`os.rename` or the `utils.diskfaults.FileSystem` seam
+(`fs.replace`/`self.fs.replace`) must, within the same function:
+
+- be PRECEDED by an `fsync` call (of the source file's handle), and
+- be FOLLOWED by an `fsync_dir` call (of the destination's parent).
+
+The check is lexical by design (like guarded-by): it cannot prove the
+fsync targets the right handle, but it pins the *shape* of every durable
+rename so the PR-5 satellite fixes cannot quietly revert. Renames of
+already-closed, already-durable files (e.g. quarantining a corrupt WAL to
+`*.corrupt`) carry a visible `# lint: disable=durable-rename` with the
+reason.
+
+String `.replace(...)` calls are ignored: only receivers that denote the
+`os` module or a filesystem seam (`fs`, `_fs`, `self.fs`, `self._fs`)
+count as renames.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Rule, Source, register
+
+# The storage modules whose renames carry durability obligations. The
+# diskfaults seam itself is excluded: its `replace()` IS the primitive
+# this rule audits the callers of.
+STORAGE_MODULES = (
+    "distributed_lms_raft_llm_tpu/raft/storage.py",
+    "distributed_lms_raft_llm_tpu/lms/persistence.py",
+    "distributed_lms_raft_llm_tpu/lms/node.py",
+)
+
+_RENAME_ATTRS = {"replace", "rename"}
+_FS_NAMES = {"fs", "_fs"}
+
+
+def _is_fs_receiver(expr: ast.expr) -> bool:
+    """True for `os`, `fs`, `_fs`, `self.fs`, `self._fs`, `<x>.fs`."""
+    if isinstance(expr, ast.Name):
+        return expr.id == "os" or expr.id in _FS_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _FS_NAMES
+    return False
+
+
+def _call_attr(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def _enclosing_scope(src: Source, node: ast.AST) -> ast.AST:
+    for anc in src.parents(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return src.tree  # module-level code
+
+
+@register
+class DurableRenameRule(Rule):
+    name = "durable-rename"
+    description = (
+        "os.replace/os.rename (or fs.replace) in a storage module without "
+        "a preceding fsync of the source file or a following parent-"
+        "directory fsync — after a crash the rename can survive while the "
+        "data (or the rename itself) did not"
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return rel in STORAGE_MODULES
+
+    def check(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in _RENAME_ATTRS:
+                continue
+            if not _is_fs_receiver(node.func.value):
+                continue  # str.replace and friends
+            scope = _enclosing_scope(src, node)
+            has_fsync_before = False
+            has_dirsync_after = False
+            for other in ast.walk(scope):
+                if not isinstance(other, ast.Call) or other is node:
+                    continue
+                attr = _call_attr(other)
+                if attr == "fsync" and other.lineno <= node.lineno:
+                    has_fsync_before = True
+                elif attr == "fsync_dir" and other.lineno >= node.lineno:
+                    has_dirsync_after = True
+            if not has_fsync_before:
+                findings.append(self.finding(
+                    src, node,
+                    f"{ast.unparse(node.func)}() without a preceding fsync "
+                    "of the source file in this function: the atomic rename "
+                    "can outlive its un-synced contents across a crash, "
+                    "leaving a durable name on an empty/partial file — "
+                    "fsync the temp file before renaming it",
+                ))
+            if not has_dirsync_after:
+                findings.append(self.finding(
+                    src, node,
+                    f"{ast.unparse(node.func)}() without a following "
+                    "fsync_dir of the destination's parent directory: the "
+                    "rename itself is not durable until the directory "
+                    "entry is — call fs.fsync_dir(parent) after renaming",
+                ))
+        return findings

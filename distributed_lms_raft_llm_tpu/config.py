@@ -136,6 +136,42 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass
+class StorageConfig:
+    """[storage] — durability and recovery behavior of the WAL, the LMS
+    state snapshot, and the blob store (raft/storage.py,
+    lms/persistence.py). One section because the knobs trade off as a
+    unit: checksums decide what corruption is *detectable*, the fsync
+    policy decides what a crash can *lose*, and the recovery mode decides
+    what a node *does* about damage it finds.
+    """
+
+    checksums: bool = True   # write v2 CRC-framed WAL records + snapshot
+    #                          integrity headers; False = legacy v1 format
+    #                          (rollback escape hatch; v1 always loads)
+    fsync: str = "always"    # "always" | "never" — fsync each WAL append;
+    #                          "never" is a dev/bench mode that trades
+    #                          crash durability for append latency
+    recovery: str = "rejoin"  # on corrupt WAL/snapshot: "rejoin" discards
+    #                           local state and restores from the leader
+    #                           (InstallSnapshot); "fail" refuses to start
+
+    def __post_init__(self) -> None:
+        # A typo'd policy must fail loudly at load time: `fsync = "on"`
+        # silently mapping to fsync-disabled would trade away durability
+        # with no warning.
+        if self.fsync not in ("always", "never"):
+            raise ValueError(
+                f"[storage] fsync must be 'always' or 'never', "
+                f"got {self.fsync!r}"
+            )
+        if self.recovery not in ("rejoin", "fail"):
+            raise ValueError(
+                f"[storage] recovery must be 'rejoin' or 'fail', "
+                f"got {self.recovery!r}"
+            )
+
+
+@dataclasses.dataclass
 class AppConfig:
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     tutoring: TutoringConfig = dataclasses.field(default_factory=TutoringConfig)
@@ -144,6 +180,7 @@ class AppConfig:
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
     )
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
 
     @property
     def client_servers(self) -> List[str]:
@@ -166,7 +203,7 @@ def load_config(path: str) -> AppConfig:
     with open(path, "rb") as fh:
         raw = tomllib.load(fh)
     unknown = set(raw) - {"cluster", "tutoring", "sampling", "gate",
-                          "resilience"}
+                          "resilience", "storage"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -185,6 +222,8 @@ def load_config(path: str) -> AppConfig:
         gate=_build(GateConfig, dict(raw.get("gate", {})), "gate"),
         resilience=_build(ResilienceConfig, dict(raw.get("resilience", {})),
                           "resilience"),
+        storage=_build(StorageConfig, dict(raw.get("storage", {})),
+                       "storage"),
     )
 
 

@@ -1,6 +1,10 @@
 """LMS application plane: state machine, persistence, service, node wiring."""
 
 from .node import LMSNode  # noqa: F401
-from .persistence import BlobStore, SnapshotStore  # noqa: F401
+from .persistence import (  # noqa: F401
+    BlobStore,
+    SnapshotCorruption,
+    SnapshotStore,
+)
 from .service import FileTransferServicer, LMSServicer  # noqa: F401
 from .state import LMSState, empty_state, hash_password  # noqa: F401

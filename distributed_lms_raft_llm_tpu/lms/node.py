@@ -24,9 +24,12 @@ from typing import Dict, Optional
 from ..raft import FileStorage, RaftConfig, RaftNode, decode_command
 from ..raft.grpc_transport import GrpcTransport
 from ..raft.messages import Entry
+from ..raft.storage import WALCorruption
+from ..utils import metrics_registry as metric
+from ..utils.diskfaults import REAL_FS, FaultyFS
 from ..utils.guards import make_tick_watchdog
 from ..utils.resilience import Deadline
-from .persistence import BlobStore, SnapshotStore
+from .persistence import BlobStore, SnapshotCorruption, SnapshotStore
 from .service import replicate_file_to_peers
 from .state import LMSState
 
@@ -44,29 +47,97 @@ class LMSNode:
         transport=None,
         snapshot_every: int = 64,
         fault_injector=None,
+        disk_fault_injector=None,
         metrics=None,
         replicate_timeout_s: float = 30.0,
         replicate_budget_s: float = 60.0,
+        storage_checksums: bool = True,
+        storage_fsync: bool = True,
+        storage_recovery: str = "rejoin",
     ):
         # snapshot_every > 1 amortizes the full-state JSON rewrite (the WAL
         # already guarantees durability; on crash, at most snapshot_every
         # entries replay). The reference rewrote everything per command.
+        if storage_recovery not in ("rejoin", "fail"):
+            raise ValueError(
+                f"storage_recovery must be 'rejoin' or 'fail', "
+                f"got {storage_recovery!r}"
+            )
         self.node_id = node_id
         self.addresses = dict(addresses)
         os.makedirs(data_dir, exist_ok=True)
-        self.snapshots = SnapshotStore(os.path.join(data_dir, "lms_data.json"))
-        self.blobs = BlobStore(os.path.join(data_dir, "uploads"))
-        self.state, applied = self.snapshots.load()
+        fs = REAL_FS
+        if disk_fault_injector is not None:
+            # Disk chaos mirrors the network plane: every byte the stores
+            # persist routes through the injector (admin target "disk").
+            fs = FaultyFS(fs, disk_fault_injector)
+        self._fs = fs
+        self.metrics = metrics
         self.snapshot_every = max(1, snapshot_every)
         self._applies_since_snapshot = 0
-        self._last_applied_index = applied
-        self.metrics = metrics
         # [resilience] replicate_timeout_s / replicate_budget_s: per-peer
         # cap and whole-sweep budget for post-commit upload replication.
         self._replicate_timeout_s = replicate_timeout_s
         self._replicate_budget_s = replicate_budget_s
 
-        storage = FileStorage(os.path.join(data_dir, "raft_wal.jsonl"))
+        snap_path = os.path.join(data_dir, "lms_data.json")
+        wal_path = os.path.join(data_dir, "raft_wal.jsonl")
+        self.blobs = BlobStore(os.path.join(data_dir, "uploads"),
+                               fs=fs, metrics=metrics)
+        # Recovery mode must survive a crash MID-recovery: the quarantine
+        # leaves clean (empty) stores behind, so without a durable marker
+        # the next boot would resume normal voting before the re-sync
+        # finished — reopening the double-vote window the mode closes.
+        # The marker is written before the quarantine renames and removed
+        # only when the heal completes (_on_recovered).
+        self._recovery_marker = os.path.join(data_dir, "storage_recovering")
+        recovering = fs.exists(self._recovery_marker)
+        if recovering:
+            log.warning("resuming interrupted storage recovery "
+                        "(marker %s present)", self._recovery_marker)
+        try:
+            self.snapshots = SnapshotStore(snap_path, fs=fs, metrics=metrics)
+            self.state, applied = self.snapshots.load()
+            storage = FileStorage(
+                wal_path, fsync=storage_fsync, checksums=storage_checksums,
+                fs=fs, metrics=metrics,
+            )
+        except (SnapshotCorruption, WALCorruption) as e:
+            if storage_recovery == "fail":
+                # Refuse standalone start: local state cannot be trusted
+                # and the operator asked not to auto-discard it.
+                raise
+            # Rejoin mode: the WAL and snapshot are one durability unit
+            # (the snapshot anchors where replay resumes) — quarantine
+            # BOTH, boot empty in recovering mode, and let the leader's
+            # InstallSnapshot/replication path restore every committed
+            # write. No acked write is lost cluster-wide: a quorum of
+            # healthy replicas still holds it.
+            log.error("local storage corrupt (%s); discarding state and "
+                      "rejoining via leader replication", e)
+            marker_f = fs.open(self._recovery_marker, "w", encoding="utf-8")
+            with marker_f:
+                fs.write(marker_f, "recovering\n")
+                fs.fsync(marker_f)
+            for path in (wal_path, snap_path):
+                if fs.exists(path):
+                    # Quarantine, not an atomic write: the source is a
+                    # closed, already-(un)durable file — there is no open
+                    # handle to fsync; the dir fsync below persists the
+                    # swap.  # lint: disable-next=durable-rename
+                    fs.replace(path, path + ".corrupt")
+            fs.fsync_dir(os.path.abspath(data_dir))
+            recovering = True
+            self.snapshots = SnapshotStore(snap_path, fs=fs, metrics=metrics)
+            self.state, applied = LMSState(), 0
+            storage = FileStorage(
+                wal_path, fsync=storage_fsync, checksums=storage_checksums,
+                fs=fs, metrics=metrics,
+            )
+        self._last_applied_index = applied
+        if metrics is not None:
+            metrics.set_gauge(metric.STORAGE_RECOVERING, int(recovering))
+
         transport = transport or GrpcTransport(self.addresses)
         if fault_injector is not None:
             # Chaos over real sockets: per-peer drop/delay/error/duplicate
@@ -86,6 +157,7 @@ class LMSNode:
             install_cb=self._install_snapshot,
             config=raft_config,
             last_applied=applied,
+            recovering=recovering,
             # Tick-lag watchdog (utils/guards.py): loop stalls export via
             # /metrics as raft_tick_lag/raft_tick_stalls. Warn threshold
             # tracks the heartbeat interval — a stall that long delays
@@ -97,6 +169,7 @@ class LMSNode:
         # Keep the file-replication peer list in sync with raft membership
         # (a server added at runtime receives blob anti-entropy too).
         self.node.membership_cb = self._on_membership
+        self.node.on_recovered = self._on_recovered
         self._on_membership(self.node.core.members)
         # Compact the WAL up to the restored snapshot and prime the
         # InstallSnapshot payload for lagging peers (a restart loses the
@@ -113,7 +186,22 @@ class LMSNode:
         await self.node.stop()
         self.snapshots.save(self.state, self._last_applied_index)
 
+    @property
+    def recovering(self) -> bool:
+        """True while local storage was discarded and the node is being
+        restored from the leader (surfaced in /healthz)."""
+        return self.node.core.recovering
+
     # ------------------------------------------------------------ internals
+
+    def _on_recovered(self) -> None:
+        log.info("storage recovery complete: log caught up to the "
+                 "leader's commit index")
+        if self._fs.exists(self._recovery_marker):
+            self._fs.remove(self._recovery_marker)
+            self._fs.fsync_dir(os.path.dirname(self._recovery_marker))
+        if self.metrics is not None:
+            self.metrics.set_gauge(metric.STORAGE_RECOVERING, 0)
 
     def _on_membership(self, members) -> None:
         for nid, address in members.items():

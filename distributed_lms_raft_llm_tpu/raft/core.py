@@ -94,6 +94,7 @@ class RaftCore:
         now: float = 0.0,
         seed: Optional[int] = None,
         last_applied: int = 0,
+        recovering: bool = False,
     ):
         self.node_id = node_id
         # peer_ids: a sequence of ids, or an id -> address mapping (the
@@ -117,6 +118,16 @@ class RaftCore:
         # InstallSnapshot to lagging peers. Not persisted here — the app
         # primes it via `compact()` (at boot and after each state snapshot).
         self.snapshot_data: Optional[bytes] = None
+
+        # Storage-recovery mode (lms.node sets this after discarding
+        # corrupt local state): the node rejoins via the leader's normal
+        # replication/InstallSnapshot path, but until its log has caught
+        # up to the leader's commit index it neither CAMPAIGNS (an empty
+        # log must not depose anyone) nor GRANTS votes (any vote cast
+        # before the crash was lost with the WAL; voting again in the
+        # same term could double-vote). Cleared on the first successful
+        # AppendEntries whose leader_commit we fully hold.
+        self.recovering = recovering
 
         # Volatile state.
         self.role = Role.FOLLOWER
@@ -335,7 +346,10 @@ class RaftCore:
             if now - self._last_heartbeat_sent >= self.config.heartbeat_interval:
                 self.broadcast_append(now)
         elif now >= self.election_deadline:
-            if not self.removed:  # a removed server never disrupts the rest
+            if self.recovering:
+                # No campaigning from discarded state; wait for a leader.
+                self._reset_election_timer(now)
+            elif not self.removed:  # a removed server never disrupts the rest
                 self.start_election(now)
 
     def start_election(self, now: float, transfer: bool = False) -> None:
@@ -424,6 +438,11 @@ class RaftCore:
             self.role is Role.LEADER
             or now - self._leader_contact < self.config.election_timeout_min
         ):
+            return VoteResponse(term=self.current_term, granted=False)
+        if self.recovering:
+            # Our pre-crash vote (if any) is gone with the WAL; granting
+            # here could be a second vote in the same term. Abstain until
+            # healed — the rest of the cluster holds quorum without us.
             return VoteResponse(term=self.current_term, granted=False)
         if req.term > self.current_term:
             self._step_down(req.term, now)
@@ -624,9 +643,31 @@ class RaftCore:
 
         if req.leader_commit > self.commit_index:
             self.commit_index = min(req.leader_commit, self.last_log_index)
+        if self.recovering and self._covers_current_term_commit(req):
+            # Healed: the leader has committed an entry OF ITS OWN TERM at
+            # req.leader_commit and our re-synced log holds it — by Leader
+            # Completeness that point covers every previously committed
+            # entry, so no acked write is missing from this replica. (A
+            # bare `last_log_index >= leader_commit` is not enough: a
+            # just-restarted leader's volatile commit_index can understate
+            # the true commit point, and healing against that stale lower
+            # bound would end vote abstention before we actually caught
+            # up.) Normal election participation resumes.
+            self.recovering = False
         return AppendResponse(
             term=self.current_term, success=True, match_index=index
         )
+
+    def _covers_current_term_commit(self, req: AppendRequest) -> bool:
+        """True when req.leader_commit names an entry of the leader's own
+        term that our log (or our leader-installed snapshot base) holds —
+        the earliest point recovery can soundly call itself complete. The
+        election no-op barrier guarantees every leader commits in its own
+        term promptly, so this resolves within a heartbeat or two."""
+        lc = req.leader_commit
+        if lc <= 0 or lc > self.last_log_index or lc < self.snapshot_index:
+            return False
+        return self.entry_term(lc) == req.term
 
     def on_append_response(
         self, peer: int, resp: AppendResponse, now: float

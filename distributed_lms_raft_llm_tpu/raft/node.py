@@ -70,11 +70,12 @@ class RaftNode:
         tick_interval: float = 0.01,
         seed: Optional[int] = None,
         last_applied: int = 0,
+        recovering: bool = False,
         watchdog=None,  # utils.guards.LoopWatchdog (optional)
     ):
         self.core = RaftCore(
             node_id, peer_ids, storage, config, now=time.monotonic(), seed=seed,
-            last_applied=last_applied,
+            last_applied=last_applied, recovering=recovering,
         )
         self.transport = transport
         self.apply_cb = apply_cb
@@ -97,6 +98,11 @@ class RaftNode:
         # Observer for membership changes (id -> address map); the LMS node
         # uses it to keep its file-replication peer list current.
         self.membership_cb: Optional[Callable[[Dict[int, str]], None]] = None
+        # Fires once when storage-recovery mode clears (the re-synced log
+        # holds everything the leader committed); the LMS node uses it to
+        # drop the storage_recovering gauge back to 0.
+        self.on_recovered: Optional[Callable[[], None]] = None
+        self._was_recovering = self.core.recovering
         self._last_members = dict(self.core.members)
         self._sync_transport_addresses()
 
@@ -292,6 +298,13 @@ class RaftNode:
                     self.apply_cb(index, entry)
                 except Exception:
                     log.exception("apply callback failed at index %d", index)
+        if self._was_recovering and not self.core.recovering:
+            self._was_recovering = False
+            if self.on_recovered is not None:
+                try:
+                    self.on_recovered()
+                except Exception:
+                    log.exception("on_recovered callback failed")
         if self.core.members != self._last_members:
             self._last_members = dict(self.core.members)
             self._sync_transport_addresses()

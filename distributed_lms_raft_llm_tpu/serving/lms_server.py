@@ -35,6 +35,7 @@ from ..lms.service import FileTransferServicer, LMSServicer
 from ..proto import rpc
 from ..raft import RaftConfig
 from ..raft.grpc_transport import RaftServicer
+from ..utils.diskfaults import DiskFaultInjector
 from ..utils.faults import FaultInjector
 from ..utils.metrics import Metrics
 from ..utils.resilience import CircuitBreaker
@@ -64,19 +65,25 @@ async def serve_async(args) -> None:
         election_timeout_max=args.election_timeout,
         heartbeat_interval=args.heartbeat_interval,
     )
-    # One injector per node shapes BOTH fault surfaces (Raft egress and the
-    # tutoring forward); dormant (zero overhead beyond a dict probe) until
-    # the admin endpoint installs a spec.
+    # One injector per node shapes BOTH network fault surfaces (Raft egress
+    # and the tutoring forward); dormant (zero overhead beyond a dict probe)
+    # until the admin endpoint installs a spec. The disk injector is its
+    # sibling for the storage plane (admin target "disk").
     faults = FaultInjector(seed=args.fault_seed)
+    disk_faults = DiskFaultInjector(seed=args.fault_seed)
     metrics = Metrics()
     lms_node = LMSNode(
         args.id, addresses, args.data_dir, raft_config=raft_config,
         snapshot_every=args.snapshot_every, fault_injector=faults,
+        disk_fault_injector=disk_faults,
         # Wires the Raft tick-lag watchdog (utils/guards.py) into /metrics:
         # raft_tick_lag histogram + raft_tick_stalls counter.
         metrics=metrics,
         replicate_timeout_s=args.replicate_timeout,
         replicate_budget_s=args.replicate_budget,
+        storage_checksums=args.storage_checksums,
+        storage_fsync=args.storage_fsync == "always",
+        storage_recovery=args.storage_recovery,
     )
 
     gate = None
@@ -154,19 +161,31 @@ async def serve_async(args) -> None:
         POST /admin/faults — chaos over real gRPC (utils/faults.py):
         {"target": "raft:2"|"tutoring"|"*", "drop": 0.3, "error": 0.1,
         "delay_s": 0.05, "delay_jitter_s": 0.05, "duplicate": 0.1} installs
-        a spec; {"clear": "raft:2"} removes one; {"reset": true} removes
-        all; {} reads the current state.
+        a spec; target "disk" routes to the storage-plane injector
+        (utils/diskfaults.py: {"target": "disk", "write_error": 0.05,
+        "fsync_error": 0.02, "bit_flip": 0.01}); {"clear": "raft:2"} (or
+        "disk") removes one; {"reset": true} removes all; {} reads the
+        current state.
         The admin plane rides the local HTTP endpoint, keeping the gRPC
         wire contract frozen."""
         if path == "/admin/faults":
             if body.get("reset"):
                 faults.clear()
+                disk_faults.clear()
             elif "clear" in body:
-                faults.clear(str(body["clear"]))
+                if str(body["clear"]) == "disk":
+                    disk_faults.clear()
+                else:
+                    faults.clear(str(body["clear"]))
             elif "target" in body:
                 spec = {k: v for k, v in body.items() if k != "target"}
-                faults.configure(str(body["target"]), **spec)
-            return {"ok": True, "faults": faults.snapshot()}
+                if str(body["target"]) == "disk":
+                    disk_faults.configure(**spec)
+                else:
+                    faults.configure(str(body["target"]), **spec)
+            snap = faults.snapshot()
+            snap["disk"] = disk_faults.snapshot()
+            return {"ok": True, "faults": snap}
         if path == "/admin/transfer":
             target = body.get("target")
             chosen = await lms_node.node.transfer_leadership(
@@ -217,6 +236,9 @@ async def serve_async(args) -> None:
                 # here without scraping /metrics.
                 "tutoring_breaker": breaker.snapshot(),
                 "faults": faults.snapshot(),
+                # Storage-recovery surface: true while this node discarded
+                # corrupt local state and is re-syncing from the leader.
+                "storage_recovering": lms_node.recovering,
             },
             admin=admin,
             port=args.metrics_port,
@@ -305,8 +327,20 @@ def main(argv=None) -> None:
                              "sweep across all peers; peers it never "
                              "reaches heal via fetch-on-miss")
     parser.add_argument("--fault-seed", type=int, default=0,
-                        help="seed for the /admin/faults chaos injector "
-                             "(deterministic fault replay)")
+                        help="seed for the /admin/faults chaos injectors "
+                             "(network and disk; deterministic replay)")
+    parser.add_argument("--storage-no-checksums", action="store_true",
+                        help="write legacy v1 (un-checksummed) WAL/snapshot "
+                             "records; v2 CRC framing is the default")
+    parser.add_argument("--storage-fsync", default="always",
+                        choices=["always", "never"],
+                        help="fsync policy for WAL appends ('never' trades "
+                             "crash durability for latency; dev/bench only)")
+    parser.add_argument("--storage-recovery", default="rejoin",
+                        choices=["rejoin", "fail"],
+                        help="on corrupt WAL/snapshot: 'rejoin' discards "
+                             "local state and restores from the leader via "
+                             "InstallSnapshot; 'fail' refuses to start")
     parser.add_argument("--no-linearizable-reads", action="store_true",
                         help="serve reads from local state without the "
                              "leadership fence (the reference's behavior)")
@@ -317,6 +351,7 @@ def main(argv=None) -> None:
     )
     args = parser.parse_args(argv)
     args.linearizable_reads = not args.no_linearizable_reads
+    args.storage_checksums = not args.storage_no_checksums
     if args.config:
         from ..config import apply_file_defaults, load_config
 
@@ -352,9 +387,15 @@ def main(argv=None) -> None:
             "replicate_timeout": cfg.resilience.replicate_timeout_s,
             "replicate_budget": cfg.resilience.replicate_budget_s,
             "fault_seed": cfg.resilience.fault_seed,
+            "storage_fsync": cfg.storage.fsync,
+            "storage_recovery": cfg.storage.recovery,
         }, argv=argv)
         if not args.no_linearizable_reads:
             args.linearizable_reads = cfg.cluster.linearizable_reads
+        if not args.storage_no_checksums:
+            # Negative flag can't carry the file value through the
+            # sentinel probe; mirror the linearizable_reads merge.
+            args.storage_checksums = cfg.storage.checksums
     elif args.id is None or args.port is None or not args.peers:
         parser.error("need either positional <id> <port> <peers...> or "
                      "--config <file> --id <node id>")

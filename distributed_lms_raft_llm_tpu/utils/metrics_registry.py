@@ -219,6 +219,34 @@ SPEC_ACCEPTED_TOKENS = counter(
     "window",
 )
 
+# Storage layer (raft/storage.py + lms/persistence.py via lms/node.py).
+
+WAL_TORN_TAIL_TRUNCATIONS = counter(
+    "wal_torn_tail_truncations",
+    "Raft WAL replays that dropped a torn final record (crash mid-append; "
+    "the record was never acked durable)",
+)
+WAL_CORRUPT_RECORDS = counter(
+    "wal_corrupt_records",
+    "Raft WAL records that failed CRC/framing checks mid-file (bit rot / "
+    "merged short write) — the node refuses to trust the log and recovers "
+    "per [storage].recovery",
+)
+SNAPSHOT_INTEGRITY_FAILURES = counter(
+    "snapshot_integrity_failures",
+    "LMS state snapshots that failed their integrity header check at load",
+)
+STORAGE_RECOVERING = gauge(
+    "storage_recovering",
+    "1 while this node has discarded corrupt local storage and is "
+    "rejoining via leader replication / InstallSnapshot; 0 once healed",
+)
+STALE_TMP_FILES_REMOVED = counter(
+    "stale_tmp_files_removed",
+    "orphaned atomic-write temp files (.raftwal.* / .lmssnap.* / .blob*) "
+    "swept at boot, leaked by a crash between mkstemp and rename",
+)
+
 # Raft runner (utils/guards.py LoopWatchdog wired by lms/node.py).
 
 RAFT_TICK_LAG = histogram(

@@ -1,0 +1,55 @@
+"""Fixture corpus for the durable-rename rule (tests/test_lint_rules.py).
+
+EXPECT markers name the lines the rule must flag; everything else must
+stay silent. A rename missing BOTH the preceding fsync and the
+following dir fsync earns two findings on the same line (one expected-
+line entry covers both — the harness compares line sets).
+"""
+
+import os
+
+
+class GoodStore:
+    def __init__(self, fs):
+        self.fs = fs
+
+    def save_atomic(self, tmp, final, f):
+        self.fs.write(f, b"payload")
+        self.fs.fsync(f)                    # data durable before the swap
+        self.fs.replace(tmp, final)
+        self.fs.fsync_dir(os.path.dirname(final))  # swap durable
+
+    def module_os_variant(self, tmp, final, f):
+        f.flush()
+        os.fsync(f.fileno())
+        os.replace(tmp, final)
+        self.fs.fsync_dir(os.path.dirname(final))
+
+
+class BadStore:
+    def __init__(self, fs):
+        self.fs = fs
+
+    def rename_without_fsync(self, tmp, final):
+        # The PR-5 blob bug: temp contents never synced, rename survives.
+        self.fs.replace(tmp, final)  # EXPECT: durable-rename
+        self.fs.fsync_dir(os.path.dirname(final))
+
+    def rename_without_dir_fsync(self, tmp, final, f):
+        self.fs.fsync(f)
+        os.rename(tmp, final)  # EXPECT: durable-rename
+
+    def rename_bare(self, tmp, final):
+        os.replace(tmp, final)  # EXPECT: durable-rename
+
+
+def not_a_rename(name: str) -> str:
+    # String .replace must not count as a filesystem rename.
+    return name.replace(".tmp", ".json")
+
+
+def sanctioned_quarantine(fs, path):
+    # Renaming an already-closed, already-durable file: no open handle to
+    # fsync.  # lint: disable-next=durable-rename
+    fs.replace(path, path + ".corrupt")
+    fs.fsync_dir(os.path.dirname(path))

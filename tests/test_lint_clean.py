@@ -58,6 +58,7 @@ def test_rule_set_covers_the_demonstrated_bug_classes():
         "metrics-registry",          # PR-4: typo'd/undocumented series
         "config-consistency",        # PR-4: dead knobs, typo'd TOML keys
         "guarded-by-flow",           # PR-4: executor escape via call graph
+        "durable-rename",            # PR-5: rename outliving its contents
     ):
         assert required in names, f"rule {required} missing from the catalog"
 

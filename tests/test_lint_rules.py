@@ -39,6 +39,9 @@ from distributed_lms_raft_llm_tpu.analysis.rules.metrics_registry import (
 from distributed_lms_raft_llm_tpu.analysis.rules.canonical_pspec import (
     CanonicalPSpecRule,
 )
+from distributed_lms_raft_llm_tpu.analysis.rules.durable_rename import (
+    DurableRenameRule,
+)
 from distributed_lms_raft_llm_tpu.analysis.rules.guarded_by import (
     GuardedByRule,
 )
@@ -103,6 +106,23 @@ def test_orphan_task_fixture():
 
 def test_guarded_by_fixture():
     run_rule(GuardedByRule(), "guarded_by.py")
+
+
+def test_durable_rename_fixture():
+    run_rule(DurableRenameRule(), "durable_rename.py")
+
+
+def test_durable_rename_scopes_to_storage_modules():
+    rule = DurableRenameRule()
+    assert rule.applies_to("distributed_lms_raft_llm_tpu/raft/storage.py")
+    assert rule.applies_to("distributed_lms_raft_llm_tpu/lms/persistence.py")
+    # The seam itself and non-storage writers stay out of scope.
+    assert not rule.applies_to(
+        "distributed_lms_raft_llm_tpu/utils/diskfaults.py"
+    )
+    assert not rule.applies_to(
+        "distributed_lms_raft_llm_tpu/models/convert.py"
+    )
 
 
 def test_tracer_hygiene_fixture():

@@ -3,6 +3,7 @@
 from distributed_lms_raft_llm_tpu.lms import (
     BlobStore,
     LMSState,
+    SnapshotCorruption,
     SnapshotStore,
     hash_password,
 )
@@ -77,10 +78,13 @@ def test_snapshot_missing_and_corrupt(tmp_path):
     store = SnapshotStore(str(tmp_path / "none.json"))
     s, idx = store.load()
     assert idx == 0 and s.data["users"] == {}
+    # Corruption is NOT absence: loading a damaged snapshot as an empty
+    # state at index 0 would silently discard every applied command the
+    # compacted WAL no longer holds (PR-5; recovery happens in lms.node).
     (tmp_path / "bad.json").write_text("{not json")
     store2 = SnapshotStore(str(tmp_path / "bad.json"))
-    s, idx = store2.load()
-    assert idx == 0
+    with pytest.raises(SnapshotCorruption):
+        store2.load()
 
 
 def test_blob_store_confines_paths(tmp_path):

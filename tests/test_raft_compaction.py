@@ -20,6 +20,7 @@ from distributed_lms_raft_llm_tpu.proto import rpc
 from distributed_lms_raft_llm_tpu.raft import Entry, FileStorage, RaftConfig
 from distributed_lms_raft_llm_tpu.raft.grpc_transport import RaftServicer
 from distributed_lms_raft_llm_tpu.raft.messages import encode_command
+from distributed_lms_raft_llm_tpu.raft.storage import _parse_line
 
 FAST = RaftConfig(
     election_timeout_min=0.11, election_timeout_max=0.22,
@@ -135,8 +136,11 @@ def test_wiped_follower_converges_via_install_snapshot(tmp_path):
             assert os.path.getsize(wal) > 0
             # Post-assertion WAL inspection in a test whose loop has nothing
             # else to run.  # lint: disable-next=no-blocking-in-async
-            with open(wal) as fh:
-                kinds = [json.loads(line)["t"] for line in fh if line.strip()]
+            with open(wal, "rb") as fh:
+                kinds = [
+                    _parse_line(line.strip())[0]["t"]
+                    for line in fh if line.strip()
+                ]
             assert "snap" in kinds
         finally:
             for n in nodes.values():

@@ -1,0 +1,464 @@
+"""Storage integrity: checksummed WAL + snapshot headers, fault handling.
+
+The disk-surface counterpart of tests/test_resilience.py: every detection
+and repair path the crash-consistent storage layer added —
+
+- WAL v2 framing round-trips; a torn tail truncates and replay continues
+  (crash semantics), while MID-FILE corruption (bit rot) raises
+  `WALCorruption` instead of silently dropping the committed suffix;
+- the LMS snapshot integrity header rejects corrupt files with
+  `SnapshotCorruption` instead of loading an empty state at index 0;
+- legacy (pre-checksum) WALs and snapshots written by the v1 code load
+  cleanly once and upgrade in place on the next compaction/save;
+- ENOSPC mid-append rolls the file back to the last good record so the
+  NEXT append cannot merge into a partial line;
+- stale temp files leak-swept at boot, counted in
+  `stale_tmp_files_removed`;
+- LMSNode recovery policy: 'fail' refuses to start on corrupt state,
+  'rejoin' quarantines it and boots in recovering mode.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_lms_raft_llm_tpu.lms.node import LMSNode
+from distributed_lms_raft_llm_tpu.lms.persistence import (
+    BlobStore,
+    SnapshotCorruption,
+    SnapshotStore,
+)
+from distributed_lms_raft_llm_tpu.lms.state import LMSState
+from distributed_lms_raft_llm_tpu.raft import Entry, FileStorage
+from distributed_lms_raft_llm_tpu.raft.node import MemNetwork
+from distributed_lms_raft_llm_tpu.raft.storage import (
+    WALCorruption,
+    _parse_line,
+    frame_record,
+)
+from distributed_lms_raft_llm_tpu.utils.diskfaults import (
+    REAL_FS,
+    DiskFault,
+    DiskFaultInjector,
+    FaultyFS,
+)
+from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+
+def write_entries(storage, first, n, term=1):
+    for i in range(first, first + n):
+        storage.append_entries(i, [Entry(term, f"cmd-{i}")])
+
+
+# ----------------------------------------------------------- WAL framing
+
+
+def test_v2_frame_round_trips():
+    rec = {"t": "entry", "i": 3, "term": 2, "cmd": "x"}
+    line = frame_record(rec)
+    parsed, legacy = _parse_line(line.strip().encode())
+    assert parsed == rec and not legacy
+
+
+def test_torn_tail_truncated_and_replay_continues(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    s = FileStorage(path, fsync=False)
+    write_entries(s, 1, 3)
+    s.close()
+    # Crash mid-append: a partial, unterminated final record.
+    with open(path, "ab") as fh:
+        fh.write(frame_record({"t": "entry", "i": 4, "term": 1,
+                               "cmd": "torn"}).encode()[:20])
+    m = Metrics()
+    s2 = FileStorage(path, fsync=False, metrics=m)
+    _, _, entries, _, _ = s2.load()
+    assert [e.command for e in entries] == ["cmd-1", "cmd-2", "cmd-3"]
+    assert m.snapshot()["counters"]["wal_torn_tail_truncations"] == 1
+    # The torn bytes are physically gone: the next append lands clean.
+    write_entries(s2, 4, 1)
+    s2.close()
+    s3 = FileStorage(path, fsync=False)
+    assert [e.command for e in s3.load()[2]] == [
+        "cmd-1", "cmd-2", "cmd-3", "cmd-4"]
+    s3.close()
+
+
+def test_midfile_corruption_refuses_to_load(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    s = FileStorage(path, fsync=False)
+    write_entries(s, 1, 5)
+    s.close()
+    raw = open(path, "rb").read()
+    # Flip one payload bit in the SECOND record (mid-file, not the tail).
+    lines = raw.splitlines(keepends=True)
+    target = lines[1]
+    pos = len(target) // 2
+    lines[1] = target[:pos] + bytes([target[pos] ^ 0x01]) + target[pos + 1:]
+    open(path, "wb").write(b"".join(lines))
+    m = Metrics()
+    with pytest.raises(WALCorruption):
+        FileStorage(path, fsync=False, metrics=m)
+    assert m.snapshot()["counters"]["wal_corrupt_records"] == 1
+
+
+def test_corrupt_final_terminated_record_is_corruption_not_torn(tmp_path):
+    """A COMPLETE (newline-terminated) final record with a bad CRC is bit
+    rot, not a torn tail: a crash truncates, it does not rewrite bytes."""
+    path = str(tmp_path / "wal.jsonl")
+    s = FileStorage(path, fsync=False)
+    write_entries(s, 1, 2)
+    s.close()
+    raw = open(path, "rb").read()
+    lines = raw.splitlines(keepends=True)
+    last = lines[-1]
+    pos = len(last) // 2
+    lines[-1] = last[:pos] + bytes([last[pos] ^ 0x01]) + last[pos + 1:]
+    open(path, "wb").write(b"".join(lines))
+    with pytest.raises(WALCorruption):
+        FileStorage(path, fsync=False)
+
+
+# ------------------------------------------------------ legacy migration
+
+
+def test_legacy_wal_loads_once_and_upgrades_on_compaction(tmp_path):
+    """A WAL written by the pre-checksum code (bare JSON lines) must boot
+    cleanly; the next compaction rewrites every record framed."""
+    path = str(tmp_path / "wal.jsonl")
+    with open(path, "w") as fh:  # exactly the v1 writer's format
+        fh.write(json.dumps({"t": "meta", "term": 4, "voted_for": 2}) + "\n")
+        for i in range(1, 6):
+            fh.write(json.dumps(
+                {"t": "entry", "i": i, "term": 4, "cmd": f"legacy-{i}"}
+            ) + "\n")
+    s = FileStorage(path, fsync=False)
+    term, voted, entries, snap_idx, _ = s.load()
+    assert (term, voted, snap_idx) == (4, 2, 0)
+    assert [e.command for e in entries] == [f"legacy-{i}" for i in range(1, 6)]
+    assert s.legacy_records == 6
+    s.compact_to(2, 4)
+    s.close()
+    # Post-compaction the file is pure v2: every line carries a CRC frame.
+    with open(path, "rb") as fh:
+        for line in fh:
+            assert not line.startswith(b"{"), "legacy line survived upgrade"
+            rec, legacy = _parse_line(line.strip())
+            assert not legacy
+    s2 = FileStorage(path, fsync=False)
+    assert [e.command for e in s2.load()[2]] == [
+        "legacy-3", "legacy-4", "legacy-5"]
+    assert s2.legacy_records == 0
+    s2.close()
+
+
+def test_legacy_snapshot_loads_once_and_upgrades_on_save(tmp_path):
+    path = str(tmp_path / "lms_data.json")
+    with open(path, "w") as fh:  # exactly the v1 writer's format
+        json.dump({"applied_index": 9,
+                   "data": {"kv": {"k": "v"}}}, fh)
+    store = SnapshotStore(path)
+    state, applied = store.load()
+    assert applied == 9 and state.data["kv"] == {"k": "v"}
+    assert store.legacy_loaded
+    store.save(state, 9)
+    raw = open(path, "rb").read()
+    assert raw.startswith(b'{"t": "lmssnap"')  # upgraded in place
+    fresh = SnapshotStore(path)
+    state2, applied2 = fresh.load()
+    assert applied2 == 9 and state2.data["kv"] == {"k": "v"}
+    assert not fresh.legacy_loaded
+
+
+def test_mixed_legacy_and_v2_wal_replays(tmp_path):
+    """The first post-upgrade boot appends v2 frames AFTER v1 lines; both
+    must replay in order until compaction homogenizes the file."""
+    path = str(tmp_path / "wal.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps(
+            {"t": "entry", "i": 1, "term": 1, "cmd": "old"}) + "\n")
+    s = FileStorage(path, fsync=False)
+    write_entries(s, 2, 1)
+    s.close()
+    s2 = FileStorage(path, fsync=False)
+    assert [e.command for e in s2.load()[2]] == ["old", "cmd-2"]
+    assert s2.legacy_records == 1
+    s2.close()
+
+
+# ----------------------------------------------------- snapshot integrity
+
+
+def test_snapshot_corruption_raises_everywhere(tmp_path):
+    path = str(tmp_path / "lms_data.json")
+    store = SnapshotStore(path)
+    state = LMSState()
+    state.data["kv"]["a"] = "1"
+    store.save(state, 17)
+    golden = open(path, "rb").read()
+    # Any single flipped byte — header or payload — must be detected.
+    for pos in range(0, len(golden), max(1, len(golden) // 23)):
+        open(path, "wb").write(
+            golden[:pos] + bytes([golden[pos] ^ 0x01]) + golden[pos + 1:]
+        )
+        with pytest.raises(SnapshotCorruption):
+            SnapshotStore(path).load()
+    # Truncation (torn write that somehow got renamed) is detected too.
+    open(path, "wb").write(golden[: len(golden) - 7])
+    with pytest.raises(SnapshotCorruption):
+        SnapshotStore(path).load()
+    open(path, "wb").write(golden)
+    st, idx = SnapshotStore(path).load()
+    assert idx == 17 and st.data["kv"] == {"a": "1"}
+
+
+def test_missing_snapshot_is_still_empty_not_error(tmp_path):
+    st, idx = SnapshotStore(str(tmp_path / "absent.json")).load()
+    assert idx == 0 and st.data["kv"] == {}
+
+
+# ------------------------------------------------------- ENOSPC handling
+
+
+def test_enospc_mid_append_rolls_back_to_last_good_record(tmp_path):
+    """A short write (ENOSPC) leaves a partial record; without the
+    truncate-back, the next in-process append merges into it and the
+    following replay refuses the merged garbage as corruption."""
+    path = str(tmp_path / "wal.jsonl")
+    inj = DiskFaultInjector(seed=7)
+    s = FileStorage(path, fsync=False, fs=FaultyFS(REAL_FS, inj))
+    write_entries(s, 1, 3)
+    inj.configure(write_error=1.0)
+    with pytest.raises(DiskFault):
+        s.append_entries(4, [Entry(1, "doomed")])
+    # In-memory state matches disk: the failed entry is NOT in the log.
+    assert [e.command for e in s.load()[2]] == ["cmd-1", "cmd-2", "cmd-3"]
+    inj.clear()
+    # The next append lands on a clean boundary and replays fine.
+    write_entries(s, 4, 1)
+    s.close()
+    s2 = FileStorage(path, fsync=False)
+    assert [e.command for e in s2.load()[2]] == [
+        "cmd-1", "cmd-2", "cmd-3", "cmd-4"]
+    s2.close()
+
+
+def test_fsync_failure_rolls_back_and_surfaces(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    inj = DiskFaultInjector(seed=7)
+    s = FileStorage(path, fs=FaultyFS(REAL_FS, inj))  # fsync=True
+    write_entries(s, 1, 2)
+    inj.configure(fsync_error=1.0)
+    with pytest.raises(DiskFault):
+        s.save_meta(5, 1)
+    assert s.load()[0] == 0  # meta unchanged: disk-first, memory-second
+    inj.clear()
+    s.save_meta(5, 1)
+    s.close()
+    assert FileStorage(path).load()[0] == 5
+
+
+def test_bit_flip_injection_is_caught_by_replay(tmp_path):
+    """End-to-end: a flipped bit on the write path (FaultyFS) produces a
+    record whose CRC fails — mid-file it refuses, at the tail it is NOT
+    torn (terminated line) so it also refuses."""
+    path = str(tmp_path / "wal.jsonl")
+    inj = DiskFaultInjector(seed=3)
+    s = FileStorage(path, fsync=False, fs=FaultyFS(REAL_FS, inj))
+    write_entries(s, 1, 2)
+    inj.configure(bit_flip=1.0)
+    write_entries(s, 3, 1)  # written corrupted, in-memory believes it
+    inj.clear()
+    write_entries(s, 4, 1)
+    s.close()
+    with pytest.raises(WALCorruption):
+        FileStorage(path, fsync=False)
+
+
+# ----------------------------------------------------- stale temp sweeps
+
+
+def test_boot_sweeps_stale_tmp_files(tmp_path):
+    wal = str(tmp_path / "wal.jsonl")
+    FileStorage(wal, fsync=False).close()
+    (tmp_path / ".raftwal.stale1").write_bytes(b"x")
+    (tmp_path / ".raftwal.stale2").write_bytes(b"x")
+    (tmp_path / ".lmssnap.stale").write_bytes(b"x")
+    blobs = tmp_path / "uploads" / "materials"
+    blobs.mkdir(parents=True)
+    (blobs / ".blob.stale").write_bytes(b"x")
+    (blobs / ".blobstream.stale").write_bytes(b"x")
+    (blobs / "real.pdf").write_bytes(b"keep me")
+    m = Metrics()
+    FileStorage(wal, fsync=False, metrics=m).close()
+    SnapshotStore(str(tmp_path / "lms_data.json"), metrics=m)
+    BlobStore(str(tmp_path / "uploads"), metrics=m)
+    assert m.snapshot()["counters"]["stale_tmp_files_removed"] == 5
+    assert not (tmp_path / ".raftwal.stale1").exists()
+    assert not (blobs / ".blob.stale").exists()
+    assert (blobs / "real.pdf").read_bytes() == b"keep me"
+
+
+# --------------------------------------------------- LMSNode recovery path
+
+
+def _corrupt_midfile(path):
+    raw = open(path, "rb").read()
+    lines = raw.splitlines(keepends=True)
+    assert len(lines) >= 2, "need a mid-file record to corrupt"
+    t = lines[1]
+    lines[1] = t[: len(t) // 2] + bytes([t[len(t) // 2] ^ 1]) \
+        + t[len(t) // 2 + 1:]
+    open(path, "wb").write(b"".join(lines))
+
+
+def _seed_node_state(tmp_path, node_id=1):
+    """Build a single-node LMSNode, apply a few commands via direct WAL
+    writes, and return its data_dir (node never started: no event loop)."""
+    data_dir = str(tmp_path / f"node{node_id}")
+    net = MemNetwork()
+    node = LMSNode(node_id, {node_id: ""}, data_dir,
+                   transport=net.transport_for(node_id))
+    storage = node.node.core.storage
+    storage.save_meta(3, None)
+    for i in range(1, 5):
+        storage.append_entries(i, [Entry(3, f"cmd-{i}")])
+    storage.close()
+    return data_dir
+
+
+def test_recovery_fail_refuses_to_start_on_corrupt_wal(tmp_path):
+    data_dir = _seed_node_state(tmp_path)
+    _corrupt_midfile(os.path.join(data_dir, "raft_wal.jsonl"))
+    net = MemNetwork()
+    with pytest.raises(WALCorruption):
+        LMSNode(1, {1: ""}, data_dir, transport=net.transport_for(1),
+                storage_recovery="fail")
+
+
+def test_recovery_rejoin_quarantines_and_boots_recovering(tmp_path):
+    data_dir = _seed_node_state(tmp_path)
+    wal = os.path.join(data_dir, "raft_wal.jsonl")
+    _corrupt_midfile(wal)
+    net = MemNetwork()
+    m = Metrics()
+    node = LMSNode(1, {1: ""}, data_dir, transport=net.transport_for(1),
+                   metrics=m)  # default recovery="rejoin"
+    assert node.recovering
+    assert node.node.core.recovering
+    assert m.snapshot()["gauges"]["storage_recovering"] == 1
+    assert m.snapshot()["counters"]["wal_corrupt_records"] == 1
+    # The damaged file is quarantined for forensics, not destroyed.
+    assert os.path.exists(wal + ".corrupt")
+    # Fresh, empty durable state: the node will re-sync from the leader.
+    assert node.node.core.last_log_index == 0
+    assert node.node.core.current_term == 0
+
+
+def test_recovery_rejoin_on_corrupt_snapshot(tmp_path):
+    data_dir = _seed_node_state(tmp_path)
+    snap = os.path.join(data_dir, "lms_data.json")
+    # Write a valid-looking but damaged v2 snapshot.
+    SnapshotStore(snap).save(LMSState(), 0)
+    raw = open(snap, "rb").read()
+    open(snap, "wb").write(raw[:30] + bytes([raw[30] ^ 1]) + raw[31:])
+    net = MemNetwork()
+    m = Metrics()
+    node = LMSNode(1, {1: ""}, data_dir, transport=net.transport_for(1),
+                   metrics=m)
+    assert node.recovering
+    assert m.snapshot()["counters"]["snapshot_integrity_failures"] == 1
+    assert os.path.exists(snap + ".corrupt")
+
+
+def test_recovery_mode_survives_restart_via_marker(tmp_path):
+    """A crash MID-recovery leaves clean (empty) stores behind; without a
+    durable marker the next boot would resume normal voting before the
+    re-sync finished."""
+    data_dir = _seed_node_state(tmp_path)
+    _corrupt_midfile(os.path.join(data_dir, "raft_wal.jsonl"))
+    net = MemNetwork()
+    node = LMSNode(1, {1: "", 2: "", 3: ""}, data_dir,
+                   transport=net.transport_for(1))
+    assert node.recovering
+    marker = os.path.join(data_dir, "storage_recovering")
+    assert os.path.exists(marker)
+    # Simulated crash mid-recovery: a fresh boot on the SAME dir (whose
+    # stores are now clean and empty) must still come up recovering.
+    node2 = LMSNode(1, {1: "", 2: "", 3: ""}, data_dir,
+                    transport=MemNetwork().transport_for(1))
+    assert node2.recovering
+    # Heal removes the marker; the boot after that is normal.
+    node2._on_recovered()
+    assert not os.path.exists(marker)
+    node3 = LMSNode(1, {1: "", 2: "", 3: ""}, data_dir,
+                    transport=MemNetwork().transport_for(1))
+    assert not node3.recovering
+
+
+def test_storage_config_rejects_typod_policies(tmp_path):
+    """`fsync = "on"` must fail at load, not silently disable fsync."""
+    from distributed_lms_raft_llm_tpu.config import load_config
+
+    cfg = tmp_path / "c.toml"
+    cfg.write_text("[storage]\nfsync = \"on\"\n")
+    with pytest.raises(ValueError, match="fsync"):
+        load_config(str(cfg))
+    cfg.write_text("[storage]\nrecovery = \"rejion\"\n")
+    with pytest.raises(ValueError, match="recovery"):
+        load_config(str(cfg))
+
+
+def test_blob_sweep_spares_wire_named_dotblob_files(tmp_path):
+    """Blob names come over the wire: the sweep matches only the exact
+    temp prefixes, and those prefixes are reserved at the API."""
+    root = str(tmp_path / "uploads")
+    b = BlobStore(root)
+    b.put("materials/.blobs-week3.pdf", b"acked upload")
+    with pytest.raises(ValueError):
+        b.put("materials/.blob.sneaky", b"x")
+    with pytest.raises(ValueError):
+        b.put("materials/.blobstream.sneaky", b"x")
+    b2 = BlobStore(root)  # restart: sweep runs
+    assert b2.get("materials/.blobs-week3.pdf") == b"acked upload"
+
+
+def test_transient_snapshot_read_error_is_not_corruption(tmp_path):
+    """EIO at load must propagate as OSError (fail the boot loudly), not
+    masquerade as corruption and trigger rejoin-mode quarantine."""
+    from distributed_lms_raft_llm_tpu.utils.diskfaults import FileSystem
+
+    path = str(tmp_path / "lms_data.json")
+    SnapshotStore(path).save(LMSState(), 3)
+
+    class EIOFS(FileSystem):
+        def read_bytes(self, p):
+            raise OSError(5, "Input/output error")
+
+    with pytest.raises(OSError) as exc:
+        SnapshotStore(path, fs=EIOFS()).load()
+    assert not isinstance(exc.value, SnapshotCorruption)
+
+
+def test_recovering_node_does_not_campaign_or_vote(tmp_path):
+    from distributed_lms_raft_llm_tpu.raft.messages import VoteRequest
+
+    data_dir = _seed_node_state(tmp_path)
+    _corrupt_midfile(os.path.join(data_dir, "raft_wal.jsonl"))
+    net = MemNetwork()
+    node = LMSNode(1, {1: "", 2: "", 3: ""}, data_dir,
+                   transport=net.transport_for(1))
+    import time
+
+    core = node.node.core
+    # Ticking far past every election deadline never starts a campaign.
+    base = time.monotonic()
+    for t in range(1, 50):
+        core.tick(base + float(t))
+    assert core.role.value == "follower" and core.outbox == []
+    # And a live candidate gets no vote from discarded state.
+    resp = core.on_vote_request(
+        VoteRequest(term=9, candidate_id=2, last_log_index=9,
+                    last_log_term=9), now=base + 100.0,
+    )
+    assert not resp.granted
