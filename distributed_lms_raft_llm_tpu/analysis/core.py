@@ -23,7 +23,7 @@ import ast
 import dataclasses
 import re
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Type
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
 
 # Matches "# lint: disable=a,b" / "disable-next=" / "disable-file=".
 _SUPPRESS_RE = re.compile(
@@ -156,6 +156,32 @@ def default_paths(root: Optional[Path] = None) -> List[Path]:
     ]
 
 
+# Shared parse cache: one entry per (root, path) serves every consumer in
+# the process — the per-file rule pass, the project model's full-tree
+# build on subset runs (which used to re-parse everything the subset pass
+# had just parsed), and repeated run_lint() calls from tests. Sources are
+# immutable after construction, so sharing is safe; the stat signature in
+# the VALUE makes a file edit replace the stale entry instead of leaking
+# it (long-lived processes — watch loops, daemons — stay bounded at one
+# Source per file).
+_SOURCE_CACHE: Dict[Tuple[str, str], Tuple[Tuple[int, int], Source]] = {}
+
+
+def _cached_source(path: Path, root: Path) -> Source:
+    try:
+        st = path.stat()
+    except OSError:
+        return Source(path, root=root)
+    key = (str(root), str(path))
+    sig = (st.st_mtime_ns, st.st_size)
+    hit = _SOURCE_CACHE.get(key)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    src = Source(path, root=root)
+    _SOURCE_CACHE[key] = (sig, src)
+    return src
+
+
 def iter_sources(
     paths: Optional[Sequence[Path]] = None, root: Optional[Path] = None
 ) -> List[Source]:
@@ -169,7 +195,7 @@ def iter_sources(
                 continue
             if any(part in EXCLUDE_PARTS for part in path.parts):
                 continue
-            out.append(Source(path, root=root))
+            out.append(_cached_source(path, root))
     return out
 
 

@@ -4,7 +4,10 @@ Each module holds one rule targeting one of this codebase's demonstrated
 bug classes (see the module docstrings for the incident each rule encodes).
 Per-file lexical rules came with PR 3; the semantic rules (deadline-flow,
 metrics-registry, config-consistency, guarded-by-flow) run on the
-whole-repo symbol table + call graph in analysis/project.py.
+whole-repo symbol table + call graph in analysis/project.py; the
+abstract-interpretation rules (pspec-flow, donation-safety, dtype-flow,
+program-inventory) additionally propagate values — sharding meaning,
+dtype, donation status, compiled-program domains — via analysis/absint.py.
 """
 
 from . import (  # noqa: F401
@@ -12,12 +15,16 @@ from . import (  # noqa: F401
     canonical_pspec,
     config_consistency,
     deadline_flow,
+    donation_safety,
+    dtype_flow,
     durable_rename,
     guarded_by,
     guarded_by_flow,
     host_sync,
     metrics_registry,
     orphan_task,
+    program_inventory,
+    pspec_flow,
     slow_marker,
     tracer_hygiene,
 )

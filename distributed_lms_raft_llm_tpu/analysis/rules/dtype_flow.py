@@ -1,0 +1,137 @@
+"""dtype-flow: hot-path arrays keep their dtype; int8 planes stay int8.
+
+The serving economics of this engine are byte economics: weight-only int8
+halves the parameter stream, the int8 KV cache halves the attention
+stream (EngineConfig.quant/kv_quant), and both wins evaporate silently if
+an engine-module expression widens the plane — `.astype(jnp.float32)` on
+a quantized plane quadruples its bytes, and jax's weak-type promotion
+does the same *invisibly* when an int array meets a bare float literal
+(`x * 0.5` promotes the whole array to the default float dtype, no cast
+in sight). Neither changes program output, so no golden test catches it;
+the step just gets slower the next time someone profiles.
+
+Three checks over the engine modules (analysis/absint.py's DtypeWalker
+propagates dtypes through assignments, constructors, `.astype`, and
+project-local calls):
+
+- **int8-upcast**: `.astype(<float>)` on a value the walker KNOWS is int8.
+  Functions whose name mentions dequantization are exempt — converting to
+  compute precision is their documented job.
+- **weak-promotion**: arithmetic between a known-int-dtype array and a
+  bare float literal — the silent full-array widening.
+- **kv-plane-cast**: `.astype(...)` directly on a KV cache plane
+  (`*.cache.k/v/ks/vs`, `c1.k`, ...) in a dispatch module. The engine
+  never converts cache planes — dequantization lives inside the models'
+  attention (models/common.py); a cast here re-materializes the whole
+  cache at the widened dtype every step, quantized or not.
+
+Unknown dtypes contribute nothing (the project model's standard trade).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set, Tuple
+
+from .. import absint
+from ..core import Finding, register
+from ..project import Project, ProjectRule
+
+# Trailing attribute pairs that name KV cache planes in engine code: the
+# owner is a cache-like binding (cache / c1 / s.cache ...), the leaf one of
+# the KVCache array fields.
+_KV_LEAVES = {"k", "v", "ks", "vs"}
+_CACHE_ROOTS = {"cache", "c1"}
+
+
+def _is_kv_plane(expr: ast.expr) -> bool:
+    chain = absint.chain_str(expr)
+    if chain is None or "." not in chain:
+        return False
+    parts = chain.split(".")
+    return parts[-1] in _KV_LEAVES and (
+        parts[-2] in _CACHE_ROOTS or (len(parts) >= 2 and
+                                      parts[-2].endswith("cache"))
+    )
+
+
+@register
+class DtypeFlowRule(ProjectRule):
+    name = "dtype-flow"
+    description = (
+        "an engine hot-path array silently widens: .astype(float) on a "
+        "known-int8 value, weak-type promotion (int array op float "
+        "literal), or any cast of a KV cache plane — each one multiplies "
+        "the bytes the decode loop streams per step"
+    )
+
+    def __init__(
+        self, watch_prefixes: Sequence[str] = (absint.ENGINE_PREFIX,)
+    ):
+        self.watch_prefixes = tuple(watch_prefixes)
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, int, str]] = set()
+        current_rel = [""]
+
+        def report(node: ast.AST, kind: str, msg: str) -> None:
+            key = (
+                current_rel[0], getattr(node, "lineno", 0),
+                getattr(node, "col_offset", 0), kind,
+            )
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(
+                    rule=self.name, path=current_rel[0],
+                    line=getattr(node, "lineno", 0), message=msg,
+                ))
+
+        walker = absint.DtypeWalker(
+            project,
+            on_upcast=lambda node, src_d, dst_d: report(
+                node, "upcast",
+                f"known-int8 value upcast via .astype({dst_d}): the plane's "
+                "quantization win is silently spent — keep int8 end-to-end "
+                "and dequantize only inside the models' compute "
+                "(models/common.py)",
+            ),
+            on_weak_promotion=lambda node, dtype: report(
+                node, "weak",
+                f"arithmetic between a {dtype} array and a bare float "
+                "literal: jax weak-type promotion silently widens the whole "
+                "array to the default float dtype — cast the literal "
+                "(jnp.asarray(c, x.dtype)) or restructure",
+            ),
+        )
+        for fn in project.functions_in(self.watch_prefixes):
+            # The walker attributes findings to the module being walked;
+            # interprocedural return-dtype evaluation may visit nodes of
+            # OTHER modules — pin the path per run and let the (rel, line,
+            # col) dedup drop the cross-attributions.
+            current_rel[0] = fn.rel
+            walker.run(fn)
+
+        # kv-plane-cast is lexical: no env needed, never exempt.
+        for rel, mod in sorted(project.modules.items()):
+            if not any(rel.startswith(p) for p in self.watch_prefixes):
+                continue
+            current_rel[0] = rel
+            for node in ast.walk(mod.src.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and _is_kv_plane(node.func.value)
+                ):
+                    continue
+                plane = absint.chain_str(node.func.value)
+                report(
+                    node, "kv-cast",
+                    f"KV cache plane `{plane}` is cast in a dispatch "
+                    "module: the engine streams cache planes as stored "
+                    "(int8 under kv_quant) and dequantizes inside the "
+                    "models' attention — a cast here re-materializes the "
+                    "whole cache at the widened dtype every step",
+                )
+        return findings

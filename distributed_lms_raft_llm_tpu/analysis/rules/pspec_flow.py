@@ -1,0 +1,83 @@
+"""pspec-flow: one MEANING per state plane, across every producer.
+
+`canonical-pspec` (PR 3) closed the spelling half of the PR-2 recompile
+incident: `P(None, None)` may no longer be written where `P()` is meant.
+This rule closes the semantic half: a SlotState plane produced under one
+sharding in `_init_state` and respelled under a *different* sharding at
+the dispatch boundary is a real layout divergence — every step program
+would either recompile per producer (when GSPMD tolerates it) or reshard
+per dispatch (when it doesn't), and both spellings can be individually
+canonical, so the lexical rule stays silent.
+
+Mechanics (analysis/absint.py): every `jax.device_put` of a named plane
+(`state.tok`, `state.cache.length`, ...) in the engine modules is
+collected with its spec evaluated to a canonical meaning — helper
+functions (`_state_spec`) resolved through their returns, nested helpers
+(`_canon_state.put`) resolved by binding call-site arguments, `P(...)`
+literals normalized by dropping trailing Nones. Planes whose resolved
+specs disagree get a finding at EVERY producing site, naming the
+conflict; unresolvable specs contribute nothing (missing resolution loses
+findings, never invents them).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from .. import absint
+from ..core import Finding, register
+from ..project import Project, ProjectRule
+
+
+@register
+class PSpecFlowRule(ProjectRule):
+    name = "pspec-flow"
+    description = (
+        "a state plane is device_put under two semantically different "
+        "PartitionSpecs across the engine's producers — the jit caches key "
+        "per producer and the dispatch boundary pays a recompile or a "
+        "reshard (the PR-2 class, beyond spelling)"
+    )
+
+    def __init__(
+        self, watch_prefixes: Sequence[str] = (absint.ENGINE_PREFIX,)
+    ):
+        self.watch_prefixes = tuple(watch_prefixes)
+
+    def check_project(self, project: Project) -> List[Finding]:
+        puts = absint.collect_plane_puts(project, self.watch_prefixes)
+        by_plane: Dict[str, List[Tuple[absint.PlanePut, str]]] = {}
+        for put in puts:
+            src = project.sources.get(put.rel)
+            if src is not None and src.suppressed(self.name, put.line):
+                # A suppressed producer is a sanctioned one-off (documented
+                # reshard): it neither reports nor counts as a conflicting
+                # producer against the plane's remaining sites.
+                continue
+            if isinstance(put.spec, str):
+                by_plane.setdefault(put.plane, []).append((put, put.spec))
+        findings: List[Finding] = []
+        seen = set()
+        for plane, sites in sorted(by_plane.items()):
+            specs = sorted({spec for _, spec in sites})
+            if len(specs) <= 1:
+                continue
+            for put, spec in sites:
+                key = (put.rel, put.line, plane)
+                if key in seen:
+                    continue
+                seen.add(key)
+                src = project.sources.get(put.rel)
+                if src is None:
+                    continue
+                findings.append(Finding(
+                    rule=self.name, path=put.rel, line=put.line,
+                    message=(
+                        f"state plane '{plane}' is produced under "
+                        f"{len(specs)} different shardings "
+                        f"({', '.join(specs)}); this site uses {spec} — "
+                        "pick ONE spec per plane so every producer shares "
+                        "one jit-cache key (see paged._state_spec)"
+                    ),
+                ))
+        return findings

@@ -512,8 +512,17 @@ class PagedEngine:
                         **statics),
                 donate_argnums=(1,),
             )
+        # Wrapped in partial like the other programs — NOT for the statics
+        # (it has none to bind) but for cache identity: jax.jit shares one
+        # program cache across wrappers of the same bare function, so a
+        # second engine in the process would see the first engine's grow
+        # programs in its counts and the inventory guard's exact-equality
+        # claim (expected_from_inventory) would read cross-engine state.
+        # A fresh partial object keys a fresh cache, per engine, like
+        # _prefill/_install/_step above.
         self._grow = jax.jit(
-            _grow_state_program, static_argnums=(1,), donate_argnums=(0,)
+            partial(_grow_state_program), static_argnums=(1,),
+            donate_argnums=(0,),
         )
         self._rng = jax.random.key(config.seed)
         self.state = self._init_state()

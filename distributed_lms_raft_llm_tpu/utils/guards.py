@@ -18,7 +18,12 @@ runtime, with the SAME vocabulary so the two halves reinforce each other:
   a context manager over jitted callables that raises `RecompileError`
   when the guarded region compiled more programs than allowed — the
   silent-recompile-per-request failure mode (`P()` vs `P(None, None)`)
-  made mechanical.
+  made mechanical. `compile_count_guard(expected_from_inventory(engine))`
+  additionally cross-validates against the static program manifest
+  (`engine/program_inventory.py`): at exit every inventoried program's
+  cache size must EQUAL the manifest's expectation — more means warmup
+  missed a program, fewer means the checked-in inventory is stale, and
+  both directions raise.
 - `LoopWatchdog` measures asyncio loop stalls: the Raft tick loop reports
   its scheduling lag here; lag lands in a Metrics histogram (exported via
   /metrics as `<name>_lag`) and stalls above the threshold warn and count
@@ -31,7 +36,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
-from typing import Any, Callable, Iterator, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence, Tuple
 
 log = logging.getLogger(__name__)
 
@@ -41,7 +46,41 @@ class RecompileError(AssertionError):
     didn't cover a live code path — the PR-2 bug class)."""
 
 
+class InventoryMismatchError(RecompileError):
+    """The runtime program caches and the static manifest
+    (engine/program_inventory.py) disagree — an uncovered program, a stale
+    inventory entry, or drifted domain math. Regenerate with
+    `python scripts/gen_program_inventory.py --write` if the change was
+    intentional."""
+
+
 # --------------------------------------------------------- transfer guards
+
+
+# One-time flag: strict dispatch on a CPU backend warns exactly once per
+# process (tests reset it to re-pin the warning).
+_warned_cpu_noop = False
+
+
+def _warn_if_cpu_noop() -> None:
+    """The jax transfer guard only fires on backends where device->host
+    readbacks move bytes; the CPU backend's readbacks are zero-copy and
+    NEVER trip it, so `--strict-dispatch` on CPU would silently enforce
+    nothing. Say so once — and point at the static rule
+    (`no-host-sync-in-dispatch`) that IS the CPU-side enforcement."""
+    global _warned_cpu_noop
+    if _warned_cpu_noop:
+        return
+    import jax
+
+    if jax.default_backend() == "cpu":
+        _warned_cpu_noop = True
+        log.warning(
+            "strict dispatch: the jax transfer guard is a no-op on the CPU "
+            "backend (readbacks are zero-copy) — unmarked syncs will NOT "
+            "raise here; the `no-host-sync-in-dispatch` lint rule is the "
+            "enforcement on CPU (see README: dlrl-lint)"
+        )
 
 
 @contextlib.contextmanager
@@ -63,9 +102,12 @@ def intended_transfer() -> Iterator[None]:
 def strict_dispatch() -> Iterator[None]:
     """Scoped strict mode: device->host readbacks outside
     `intended_transfer()` raise (on backends where readbacks are real
-    transfers). Engine test fixtures wrap hot-path runs in this."""
+    transfers; on CPU this is a documented no-op — a one-time warning
+    points at the lint rule that enforces there). Engine test fixtures
+    wrap hot-path runs in this."""
     import jax
 
+    _warn_if_cpu_noop()
     with jax.transfer_guard_device_to_host("disallow"):
         yield
 
@@ -77,6 +119,7 @@ def enable_strict_dispatch() -> None:
     hide in the live path."""
     import jax
 
+    _warn_if_cpu_noop()
     jax.config.update("jax_transfer_guard_device_to_host", "disallow")
     log.info("strict dispatch: unmarked device->host transfers will raise")
 
@@ -107,6 +150,49 @@ class _CompileCounts:
         )
 
 
+class InventoryExpectation:
+    """Absolute expected program-cache sizes for an engine's inventoried
+    (warmup-covered) programs, from the static manifest. Built via
+    `expected_from_inventory(engine)`; consumed by `compile_count_guard`.
+    """
+
+    def __init__(self, engine: object):
+        from ..engine import program_inventory as _inv
+
+        self.engine = engine
+        self.expected = _inv.expected_counts(engine)  # attr -> size
+        self.fns = {
+            attr: getattr(engine, attr) for attr in sorted(self.expected)
+        }
+
+    def mismatches(self) -> Dict[str, Tuple[int, int]]:
+        """{attr: (actual, expected)} for every program whose live cache
+        size differs from the manifest expectation, in either direction."""
+        out: Dict[str, Tuple[int, int]] = {}
+        for attr, fn in self.fns.items():
+            actual = _CompileCounts._size(fn)
+            if actual != self.expected[attr]:
+                out[attr] = (actual, self.expected[attr])
+        return out
+
+
+def expected_from_inventory(engine: object) -> InventoryExpectation:
+    """The static<->runtime cross-validation mode of `compile_count_guard`:
+
+        eng.warmup()
+        with compile_count_guard(expected_from_inventory(eng)):
+            ... live serving ...
+
+    The region must compile nothing new (the classic warmup-coverage
+    claim), AND at exit every program named by engine/program_inventory.py
+    must hold EXACTLY the manifest's expected count — more means an
+    uncovered program slipped through, fewer means the checked-in
+    inventory overstates the domain (stale manifest). Either direction
+    raises InventoryMismatchError.
+    """
+    return InventoryExpectation(engine)
+
+
 @contextlib.contextmanager
 def compile_count_guard(
     *fns: object, allow: int = 0, what: str = "guarded region"
@@ -123,7 +209,20 @@ def compile_count_guard(
         with compile_count_guard(eng._step, eng._install) as guard:
             eng.drain()
         # guard.new_compiles() also available for reporting
+
+    Passing `expected_from_inventory(engine)` as the sole argument guards
+    the engine's whole inventoried program set and additionally asserts
+    the post-region cache sizes EQUAL the static manifest's expectations
+    (see expected_from_inventory).
     """
+    expectation: Optional[InventoryExpectation] = None
+    if len(fns) == 1 and isinstance(fns[0], InventoryExpectation):
+        expectation = fns[0]
+        fns = tuple(expectation.fns.values())
+        what = (
+            f"{type(expectation.engine).__name__} inventoried program set"
+            if what == "guarded region" else what
+        )
     counts = _CompileCounts(fns)
     yield counts
     new = counts.new_compiles()
@@ -133,6 +232,19 @@ def compile_count_guard(
             "warmup does not cover a live code path — check for "
             "spelling-different shardings or unexpected shapes"
         )
+    if expectation is not None:
+        bad = expectation.mismatches()
+        if bad:
+            detail = ", ".join(
+                f"{attr}: {actual} compiled vs {exp} inventoried"
+                for attr, (actual, exp) in sorted(bad.items())
+            )
+            raise InventoryMismatchError(
+                f"{what} disagrees with engine/program_inventory.py "
+                f"({detail}) — more than inventoried means warmup missed a "
+                "program; fewer means the manifest is stale "
+                "(scripts/gen_program_inventory.py --write)"
+            )
 
 
 # ---------------------------------------------------------- loop watchdog

@@ -4,6 +4,7 @@
     python scripts/lint.py                 # whole tree (package+scripts+tests)
     python scripts/lint.py --json          # machine-readable findings
     python scripts/lint.py --rules guarded-by,deadline-flow engine/
+    python scripts/lint.py --changed       # only git-changed files (pre-commit)
     python scripts/lint.py --baseline lint-baseline.json   # fail on NEW only
     python scripts/lint.py --types         # + the mypy strict-subset gate
     python scripts/lint.py --list-rules    # the catalog
@@ -53,6 +54,7 @@ sys.path.insert(0, str(REPO))
 
 from distributed_lms_raft_llm_tpu.analysis import (  # noqa: E402
     all_rules,
+    default_paths,
     run_lint,
 )
 
@@ -67,6 +69,41 @@ TYPED_SUBSET = [
 ]
 
 _BaselineKey = Tuple[str, str, str]
+
+
+def changed_paths() -> List[Path]:
+    """Lintable files the checkout touched: `git status --porcelain`
+    covers staged, unstaged, AND untracked in one listing (renames report
+    the new name). Deleted files and non-Python artifacts are dropped."""
+    proc = subprocess.run(
+        # -uall: report untracked files individually — the default
+        # collapses a new directory to one "dir/" entry and every .py
+        # under it would silently skip the run.
+        ["git", "status", "--porcelain", "--no-renames", "-uall"],
+        cwd=str(REPO), capture_output=True, text=True, check=True,
+    )
+    out: List[Path] = []
+    for line in proc.stdout.splitlines():
+        status, rel = line[:2], line[3:]
+        if status == "!!" or status.strip() == "D":
+            continue
+        if rel.startswith('"') and rel.endswith('"'):
+            # git C-quotes names with spaces/non-ASCII (octal escapes);
+            # undo it or the file silently drops out of the run.
+            rel = (
+                rel[1:-1].encode("ascii", "backslashreplace")
+                .decode("unicode_escape").encode("latin-1").decode("utf-8")
+            )
+        path = REPO / rel
+        if path.suffix != ".py" or not path.is_file():
+            continue
+        # Only files the full gate covers: a repo-root stray (bench.py)
+        # would otherwise make --changed and the tier-1 clean run disagree
+        # about what "clean" means.
+        if any(path.resolve().is_relative_to(base.resolve())
+               for base in default_paths(REPO)):
+            out.append(path)
+    return sorted(out)
 
 
 def _baseline_key(f: Dict[str, object]) -> _BaselineKey:
@@ -115,6 +152,12 @@ def main(argv=None) -> int:
     parser.add_argument("paths", nargs="*",
                         help="files/directories to lint (default: the "
                              "package, scripts/ and tests/)")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files git reports as changed "
+                             "(staged, unstaged, or untracked) — the "
+                             "pre-commit loop; project rules still analyze "
+                             "the full tree but report only into changed "
+                             "paths")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the dlrl-lint/1 JSON document")
     parser.add_argument("--rule", "--rules", action="append", default=None,
@@ -157,7 +200,24 @@ def main(argv=None) -> int:
         rules = [r for r in rules if r.name in wanted]
 
     paths = [Path(p) for p in args.paths] or None
-    findings = run_lint(paths=paths, rules=rules, root=REPO)
+    nothing_changed = False
+    if args.changed:
+        if paths is not None:
+            print("--changed and explicit paths are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        try:
+            paths = changed_paths()
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"--changed needs a git checkout: {e}", file=sys.stderr)
+            return 2
+        # An empty changed set is trivially clean — but fall through to
+        # the normal output stage so --json still emits the dlrl-lint/1
+        # document and --write-baseline still writes a (empty) baseline.
+        nothing_changed = not paths
+    findings = [] if nothing_changed else run_lint(
+        paths=paths, rules=rules, root=REPO
+    )
 
     if args.write_baseline is not None:
         args.write_baseline.write_text(json.dumps({

@@ -1,0 +1,78 @@
+"""donation-safety fixture: donated buffers die at dispatch.
+
+True positives: a read after the donating call, a read through an alias
+taken before it (even when the call rebinds the donated name), a donating
+call in a loop that never rebinds, and a donated `self.attr` the
+statement doesn't rebind. True negatives: the rebind-in-one-statement
+idiom, sibling branches (no order between them), a compile-only throwaway
+donation, and a suppressed sanctioned case.
+"""
+
+from functools import partial
+
+import jax
+
+
+def _step_program(params, state, rng):
+    return state
+
+
+def _grow_program(state, n):
+    return state
+
+
+def make_state():
+    return None
+
+
+class Engine:
+    def __init__(self):
+        self._step = jax.jit(partial(_step_program), donate_argnums=(1,))
+        self._grow = jax.jit(_grow_program, donate_argnums=(0,))
+        self.state = make_state()
+
+    def good_step(self, params, state, rng):
+        state = self._step(params, state, rng)
+        return state
+
+    def read_after_donate(self, params, state, rng):
+        out = self._step(params, state, rng)
+        return out, state.tok  # EXPECT: donation-safety
+
+    def alias_read(self, params, state, rng):
+        snap = state
+        state = self._step(params, state, rng)
+        return state, snap.tok  # EXPECT: donation-safety
+
+    def branches_are_unordered(self, params, state, rng, flag):
+        if flag:
+            out = self._step(params, state, rng)
+        else:
+            out = self._step(params, state, rng)
+        return out
+
+    def loop_rebind_ok(self, params, state, rng):
+        for _ in range(3):
+            state = self._step(params, state, rng)
+        return state
+
+    def loop_never_rebinds(self, params, state, rng):
+        for _ in range(3):
+            self._step(params, state, rng)  # EXPECT: donation-safety
+
+    def attr_rebound_ok(self, params, rng):
+        self.state = self._step(params, self.state, rng)
+
+    def attr_not_rebound(self, params, rng):
+        out = self._step(params, self.state, rng)  # EXPECT: donation-safety
+        return out
+
+    def throwaway_warmup(self, params, rng):
+        # Compile-only dispatch of a fresh local: nothing reads it later.
+        state = make_state()
+        self._grow(state, 8)
+
+    def sanctioned(self, params, state, rng):
+        # A backend quirk needs the pre-donation handle for its shape only.
+        out = self._step(params, state, rng)
+        return out, state.shape  # lint: disable=donation-safety

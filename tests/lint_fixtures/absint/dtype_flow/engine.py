@@ -1,0 +1,71 @@
+"""dtype-flow fixture: hot-path arrays keep their dtype.
+
+True positives: `.astype(float)` on a value the walker knows is int8
+(directly, through an assignment, and through a project-local helper's
+return), weak-type promotion (known-int array times a bare float
+literal), and a cast of a KV cache plane. True negatives: casts between
+int dtypes, float work on values of unknown dtype, dequant-named
+functions (their job), and a suppressed sanctioned case.
+"""
+
+import jax.numpy as jnp
+
+
+def quantize(x):
+    return x.astype(jnp.int8)
+
+
+def dequantize_plane(q, scale):
+    # Dequantization is the sanctioned int8 -> float conversion point.
+    return q.astype(jnp.float32) * scale
+
+
+def upcast_direct():
+    q = jnp.zeros((4, 4), jnp.int8)
+    return q.astype(jnp.float32)  # EXPECT: dtype-flow
+
+
+def upcast_through_assignment(x):
+    q = x.astype(jnp.int8)
+    wide = q.astype(jnp.bfloat16)  # EXPECT: dtype-flow
+    return wide
+
+
+def upcast_through_helper(x):
+    q = quantize(x)
+    return q.astype(jnp.float32)  # EXPECT: dtype-flow
+
+
+def weak_promotion():
+    counts = jnp.zeros((8,), jnp.int32)
+    return counts * 0.5  # EXPECT: dtype-flow
+
+
+def weak_promotion_int8(x):
+    q = x.astype(jnp.int8)
+    return 0.125 * q  # EXPECT: dtype-flow
+
+
+def kv_plane_cast(state):
+    return state.cache.k.astype(jnp.float32)  # EXPECT: dtype-flow
+
+
+def int_to_int_is_fine():
+    q = jnp.zeros((4,), jnp.int8)
+    return q.astype(jnp.int32)
+
+
+def unknown_dtype_is_silent(x):
+    # x's dtype is unknown: no fact, no finding (unsound-by-design).
+    return x.astype(jnp.float32) * 0.5
+
+
+def int_times_int_literal_is_fine():
+    counts = jnp.zeros((8,), jnp.int32)
+    return counts * 2
+
+
+def sanctioned(x):
+    q = x.astype(jnp.int8)
+    # One-off float view for a debug histogram; documented.
+    return q.astype(jnp.float32)  # lint: disable=dtype-flow

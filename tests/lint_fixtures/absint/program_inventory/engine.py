@@ -1,0 +1,44 @@
+"""program-inventory fixture engine: jit sites vs the checked-in manifest.
+
+`_step` is inventoried and warmup-covered through a helper (call-graph
+coverage counts). `_prefill` is inventoried as warmup-covered but warmup
+never reaches it — flagged on the warmup def. `_rogue` is uninventoried;
+`_tmp` is uninventoried but suppressed with a reason. `_drifted` exists
+in both but the donation contracts disagree.
+"""
+
+from functools import partial
+
+import jax
+
+
+def _step_program(params, state, rng):
+    return state
+
+
+def _prefill_program(params, ids):
+    return ids
+
+
+def _drift_program(state):
+    return state
+
+
+def _rogue_program(x):
+    return x
+
+
+class MiniEngine:
+    def __init__(self):
+        self._step = jax.jit(partial(_step_program), donate_argnums=(1,))
+        self._prefill = jax.jit(_prefill_program)
+        self._drifted = jax.jit(_drift_program, donate_argnums=(0,))  # EXPECT: program-inventory
+        self._rogue = jax.jit(_rogue_program)  # EXPECT: program-inventory
+        # Experimental program, deliberately unclassified while it bakes.
+        self._tmp = jax.jit(_rogue_program)  # lint: disable=program-inventory
+
+    def warmup(self):  # EXPECT: program-inventory
+        self._run_once()
+
+    def _run_once(self):
+        self._step(None, None, None)

@@ -1,0 +1,29 @@
+"""Fixture manifest (parsed by the rule, never imported).
+
+`_step`/`_prefill` match live sites; `_drifted`'s donation contract
+disagrees with its site (reported there); `_gone` matches nothing —
+stale, reported here.
+"""
+
+INVENTORY = (
+    ProgramEntry(  # noqa: F821 - parse-only fixture
+        engine="MiniEngine", attr="_step", target="_step_program",
+        donate_argnums=(1,), static_argnums=(),
+        domain="widths", coverage="warmup",
+    ),
+    ProgramEntry(  # noqa: F821 - parse-only fixture
+        engine="MiniEngine", attr="_prefill", target="_prefill_program",
+        donate_argnums=(), static_argnums=(),
+        domain="buckets", coverage="warmup",
+    ),
+    ProgramEntry(  # noqa: F821 - parse-only fixture
+        engine="MiniEngine", attr="_drifted", target="_drift_program",
+        donate_argnums=(), static_argnums=(),
+        domain="shapes", coverage="on-demand",
+    ),
+    ProgramEntry(  # EXPECT: program-inventory
+        engine="MiniEngine", attr="_gone", target="_gone_program",
+        donate_argnums=(), static_argnums=(),
+        domain="shapes", coverage="on-demand",
+    ),
+)

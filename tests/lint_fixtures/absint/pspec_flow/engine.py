@@ -1,0 +1,50 @@
+"""pspec-flow fixture: per-plane sharding MEANING must be consistent.
+
+Planes here: 'tok' (consistent through a helper), 'lengths' (two
+semantically different specs — both spellings canonical, so only
+pspec-flow sees it), 'seen' (spelling-different but meaning-identical —
+must stay silent), 'extra' (one producer suppressed with a reason — the
+sanctioned reshard neither reports nor creates a conflict).
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _state_spec(x):
+    del x
+    return P()
+
+
+def init_state(mesh, state):
+    tok = jax.device_put(
+        state.tok, NamedSharding(mesh, _state_spec(state.tok))
+    )
+    lengths = jax.device_put(state.lengths, NamedSharding(mesh, P()))  # EXPECT: pspec-flow
+    # P(None) means the same layout as P(): semantic normalization keeps
+    # this silent under pspec-flow (the spelling is canonical-pspec's job).
+    seen = jax.device_put(
+        state.seen,
+        NamedSharding(mesh, P(None)),  # lint: disable=canonical-pspec
+    )
+    return tok, lengths, seen
+
+
+def canon_state(mesh, state):
+    def put(x, spec=None):
+        sh = NamedSharding(mesh, spec if spec is not None else _state_spec(x))
+        return jax.device_put(x, sh)
+
+    tok = put(state.tok)
+    lengths = put(state.lengths, P("dp"))  # EXPECT: pspec-flow
+    seen = put(state.seen)
+    return tok, lengths, seen
+
+
+def sanctioned_reshard(mesh, state):
+    # Cold-path gather onto dp for a one-off debug dump; deliberate.
+    return jax.device_put(state.extra, NamedSharding(mesh, P("dp")))  # lint: disable=pspec-flow
+
+
+def steady_producer(mesh, state):
+    return jax.device_put(state.extra, NamedSharding(mesh, P()))

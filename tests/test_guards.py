@@ -76,6 +76,41 @@ def test_strict_dispatch_sets_and_restores_transfer_guard():
     assert jax.config.jax_transfer_guard_device_to_host == before
 
 
+def test_strict_dispatch_warns_once_on_cpu_backend():
+    """On the CPU backend the transfer guard is a physical no-op (CPU
+    readbacks are zero-copy): strict dispatch must say so ONCE and point
+    at the lint rule that enforces there — never silently pretend to
+    guard."""
+    import logging
+
+    from distributed_lms_raft_llm_tpu.utils import guards
+
+    assert jax.default_backend() == "cpu", "suite runs on the CPU backend"
+    guards._warned_cpu_noop = False  # re-arm the one-time warning
+    try:
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        logger = logging.getLogger("distributed_lms_raft_llm_tpu.utils.guards")
+        logger.addHandler(handler)
+        try:
+            with strict_dispatch():
+                pass
+            with strict_dispatch():  # second entry: no second warning
+                pass
+        finally:
+            logger.removeHandler(handler)
+        warnings = [
+            r for r in records
+            if r.levelno == logging.WARNING and "no-op on the CPU" in
+            r.getMessage()
+        ]
+        assert len(warnings) == 1, [r.getMessage() for r in records]
+        assert "no-host-sync-in-dispatch" in warnings[0].getMessage()
+    finally:
+        guards._warned_cpu_noop = True  # leave the suite quiet
+
+
 def test_engine_hot_path_runs_under_strict_dispatch():
     """The paged engine's submit->step->reap loop completes under strict
     dispatch: every host sync on the path is wrapped in

@@ -59,6 +59,10 @@ def test_rule_set_covers_the_demonstrated_bug_classes():
         "config-consistency",        # PR-4: dead knobs, typo'd TOML keys
         "guarded-by-flow",           # PR-4: executor escape via call graph
         "durable-rename",            # PR-5: rename outliving its contents
+        "pspec-flow",                # PR-6: semantic sharding divergence
+        "donation-safety",           # PR-6: use-after-donate
+        "dtype-flow",                # PR-6: silent hot-path widening
+        "program-inventory",         # PR-6: jit entry points vs manifest
     ):
         assert required in names, f"rule {required} missing from the catalog"
 
@@ -66,20 +70,25 @@ def test_rule_set_covers_the_demonstrated_bug_classes():
 # ------------------------------------------------------- reversion pins
 
 
-def _project_with_patched_service(old: str, new: str) -> Project:
-    """The real repo tree, with one textual edit to lms/service.py —
-    exactly what `git revert` of a sweep fix would produce."""
+def _project_with_patch(rel: str, *edits) -> Project:
+    """The real repo tree, with textual edits to one file — exactly what
+    `git revert` of a sweep fix would produce."""
     root = repo_root()
     sources = iter_sources(None, root=root)
     patched = []
     for src in sources:
-        if src.rel == SERVICE:
+        if src.rel == rel:
             text = src.text
-            assert old in text, f"pin is stale: {old!r} not in {SERVICE}"
-            src = type(src)(src.path, root=root,
-                            text=text.replace(old, new, 1))
+            for old, new in edits:
+                assert old in text, f"pin is stale: {old!r} not in {rel}"
+                text = text.replace(old, new, 1)
+            src = type(src)(src.path, root=root, text=text)
         patched.append(src)
     return Project(patched, root=root)
+
+
+def _project_with_patched_service(old: str, new: str) -> Project:
+    return _project_with_patch(SERVICE, (old, new))
 
 
 def test_reverting_blob_fetch_timeout_fix_fails_lint():
@@ -113,6 +122,118 @@ def test_unregistered_metric_name_fails_lint():
         if f.path == SERVICE and "tutoring_degarded" in f.message
     ]
     assert findings, "a typo'd metric name must fail metrics-registry"
+
+
+# ------------------------------------------- reversion pins (absint, PR 6)
+
+
+PAGED = "distributed_lms_raft_llm_tpu/engine/paged.py"
+
+
+def test_semantically_divergent_state_plane_spec_fails_lint():
+    """Re-introducing a state-plane spec that differs in MEANING (both
+    spellings individually canonical, so `canonical-pspec` stays silent)
+    must fail pspec-flow — the class behind the PR-2 recompile."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.pspec_flow import (
+        PSpecFlowRule,
+    )
+
+    project = _project_with_patch(PAGED, (
+        "sh = jax.sharding.NamedSharding(self.mesh, _state_spec(x))",
+        'sh = jax.sharding.NamedSharding(self.mesh, '
+        'jax.sharding.PartitionSpec("dp"))',
+    ))
+    findings = [
+        f for f in PSpecFlowRule().check_project(project) if f.path == PAGED
+    ]
+    assert findings, "a dispatch-boundary respell under a different " \
+        "sharding must fail pspec-flow"
+
+
+def test_unrebound_donated_state_fails_lint():
+    """Donating the live SlotState without rebinding `self.state` in the
+    same statement leaves the engine pointing at deleted buffers — the
+    exact failure PagedEngine.reset documents."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.donation_safety import (
+        DonationSafetyRule,
+    )
+
+    project = _project_with_patch(PAGED, (
+        "self.state, toks, active = self._step(\n"
+        "                        self.params, self.state, rng\n"
+        "                    )",
+        "toks, active = self._step(\n"
+        "                        self.params, self.state, rng\n"
+        "                    )[1:]",
+    ))
+    findings = [
+        f for f in DonationSafetyRule().check_project(project)
+        if f.path == PAGED
+    ]
+    assert findings, "a donated self.state with no rebinding must fail " \
+        "donation-safety"
+
+
+def test_removing_warmup_coverage_fails_lint():
+    """Gutting warmup's step coverage (the direct step AND the drain that
+    reaches step through the call graph) must fail program-inventory —
+    the static half; partial removals that static reachability cannot see
+    are the runtime guard's half (tests/test_program_inventory.py)."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.program_inventory import (
+        ProgramInventoryRule,
+    )
+
+    project = _project_with_patch(PAGED, (
+        "self.state = self._step(self.params, self.state, rng)[0]",
+        "pass",
+    ), (
+        'rid = self.submit("warmup")\n        self.drain()',
+        "rid = 0",
+    ))
+    findings = [
+        f for f in ProgramInventoryRule().check_project(project)
+        if "warmup no longer covers" in f.message
+    ]
+    assert findings, "a warmup that cannot reach _step must fail " \
+        "program-inventory"
+
+
+def test_uninventoried_jit_entry_fails_lint():
+    from distributed_lms_raft_llm_tpu.analysis.rules.program_inventory import (
+        ProgramInventoryRule,
+    )
+
+    project = _project_with_patch(PAGED, (
+        "self._grow = jax.jit(",
+        "self._rogue = jax.jit(\n"
+        "            _grow_state_program, static_argnums=(1,), "
+        "donate_argnums=(0,)\n"
+        "        )\n"
+        "        self._grow = jax.jit(",
+    ))
+    findings = [
+        f for f in ProgramInventoryRule().check_project(project)
+        if "uninventoried" in f.message
+    ]
+    assert findings, "a new jit entry point missing from the manifest " \
+        "must fail program-inventory"
+
+
+# ------------------------------------------------------ lint wall budget
+
+
+def test_full_lint_run_stays_within_wall_budget():
+    """The suite runs the full rule set several times (here, the CLI
+    test, fixture tests); the shared AST cache keeps that cheap. Budget
+    chosen ~4x the measured cold time so CI noise can't flake it, while
+    an accidental O(files^2) regression still fails loudly."""
+    import time
+
+    t0 = time.monotonic()
+    findings = run_lint()
+    dt = time.monotonic() - t0
+    assert not findings
+    assert dt < 20.0, f"full lint run took {dt:.1f}s (budget 20s)"
 
 
 # --------------------------------------------------- registry <-> README

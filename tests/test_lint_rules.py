@@ -39,6 +39,18 @@ from distributed_lms_raft_llm_tpu.analysis.rules.metrics_registry import (
 from distributed_lms_raft_llm_tpu.analysis.rules.canonical_pspec import (
     CanonicalPSpecRule,
 )
+from distributed_lms_raft_llm_tpu.analysis.rules.donation_safety import (
+    DonationSafetyRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.dtype_flow import (
+    DtypeFlowRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.program_inventory import (
+    ProgramInventoryRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.pspec_flow import (
+    PSpecFlowRule,
+)
 from distributed_lms_raft_llm_tpu.analysis.rules.durable_rename import (
     DurableRenameRule,
 )
@@ -137,14 +149,15 @@ def test_slow_marker_fixture():
 
 
 SEMANTIC = FIXTURES / "semantic"
+ABSINT = FIXTURES / "absint"
 
 
-def run_project_rule(rule, case: str):
-    """Run a ProjectRule over the mini-project at semantic/<case>/ and
+def run_project_rule(rule, case: str, base: Path = SEMANTIC):
+    """Run a ProjectRule over the mini-project at <base>/<case>/ and
     compare flagged lines per file to `# EXPECT: <rule>` markers in every
     .py AND .toml file of the case (suppressions applied, as run_lint
     does)."""
-    case_dir = SEMANTIC / case
+    case_dir = base / case
     sources = [
         Source(path, root=case_dir)
         for path in sorted(case_dir.rglob("*.py"))
@@ -193,6 +206,35 @@ def test_config_consistency_fixture():
 
 def test_guarded_by_flow_fixture():
     run_project_rule(GuardedByFlowRule(), "guarded_by_flow")
+
+
+# ------------------------------------------- abstract interpretation
+
+
+def test_pspec_flow_fixture():
+    run_project_rule(
+        PSpecFlowRule(watch_prefixes=("",)), "pspec_flow", base=ABSINT
+    )
+
+
+def test_donation_safety_fixture():
+    run_project_rule(
+        DonationSafetyRule(watch_prefixes=("",)), "donation_safety",
+        base=ABSINT,
+    )
+
+
+def test_dtype_flow_fixture():
+    run_project_rule(
+        DtypeFlowRule(watch_prefixes=("",)), "dtype_flow", base=ABSINT
+    )
+
+
+def test_program_inventory_fixture():
+    run_project_rule(
+        ProgramInventoryRule(scan_prefixes=("",), manifest_rel="inventory.py"),
+        "program_inventory", base=ABSINT,
+    )
 
 
 def test_same_line_emissions_are_all_checked(tmp_path):

@@ -1,0 +1,143 @@
+"""The compiled-program inventory: static manifest <-> runtime caches.
+
+Three claims, each pinned:
+
+- the checked-in manifest and README table match what the generator
+  derives from the tree (drift fails tier-1, same scheme as the metrics
+  table);
+- after `warmup()`, a live paged session under
+  `compile_count_guard(expected_from_inventory(eng))` compiles nothing
+  and every inventoried program's cache size EQUALS the manifest's
+  expectation — the acceptance path;
+- both drift directions raise: skipping warmup (uncovered programs
+  compile live) and a stale expectation (manifest counts the engine
+  doesn't have).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_lms_raft_llm_tpu.engine import (
+    EngineConfig,
+    PagedEngine,
+    SamplingParams,
+    TutoringEngine,
+)
+from distributed_lms_raft_llm_tpu.engine import program_inventory as inv
+from distributed_lms_raft_llm_tpu.utils.guards import (
+    InventoryMismatchError,
+    RecompileError,
+    compile_count_guard,
+    expected_from_inventory,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def make_engine(**kw):
+    kw.setdefault("length_buckets", (4, 16))
+    return PagedEngine(
+        EngineConfig(
+            model="tiny",
+            sampling=SamplingParams.greedy(max_new_tokens=8),
+            batch_buckets=(1, 2),
+            dtype=jnp.float32,
+            **kw,
+        ),
+        slots=2, chunk=2,
+    )
+
+
+# ----------------------------------------------------- generated artifacts
+
+
+def test_manifest_and_readme_match_static_scan():
+    """scripts/gen_program_inventory.py --check: the INVENTORY block and
+    the README program-inventory table are regenerated and compared."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "gen_program_inventory.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_manifest_covers_the_paged_program_set():
+    attrs = {e.attr for e in inv.entries_for("PagedEngine")}
+    assert attrs == {"_prefill", "_install", "_step", "_grow"}
+    assert all(
+        e.coverage == "warmup" for e in inv.entries_for("PagedEngine")
+    ), "the paged engine's whole program set is a warmup promise"
+
+
+def test_static_domain_math_is_engine_math():
+    """static_paged_domain mirrors PagedEngine.__init__'s derivation for
+    representative configs (incl. spec-mode overhang and bucket capping)."""
+    for spec_tokens, buckets in ((0, (4, 16)), (3, (4, 8, 16)), (2, (16,))):
+        eng = make_engine(length_buckets=buckets, spec_tokens=spec_tokens)
+        dom = inv.static_paged_domain(
+            eng.cfg.max_position_embeddings,
+            eng.config.sampling.max_new_tokens,
+            buckets, spec_tokens,
+        )
+        assert dom["widths"] == list(eng.widths)
+        assert max(dom["buckets"]) <= eng.bucket
+
+
+# ------------------------------------------------- runtime cross-validation
+
+
+def test_warmed_paged_session_passes_inventory_guard():
+    """The acceptance path: warmup compiles exactly the inventoried
+    domain, then a live session (two widths, slot churn) adds nothing."""
+    eng = make_engine()
+    eng.warmup()
+    expectation = expected_from_inventory(eng)
+    # The static counts ARE the live caches post-warmup...
+    assert expectation.mismatches() == {}
+    # ...and stay so through a live session.
+    with compile_count_guard(expectation) as guard:
+        eng.submit("k v")
+        eng.step()
+        eng.submit("a longer question about raft elections and logs")
+        eng.drain()
+    assert guard.new_compiles() == 0
+
+
+def test_missing_warmup_fails_the_inventory_guard():
+    """Removing warmup coverage the static rule can't see (warmup still
+    REACHES every program, it just compiles fewer shapes) is the runtime
+    guard's half: an unwarmed engine compiles live and the guard raises."""
+    eng = make_engine()  # no warmup()
+    with pytest.raises(RecompileError):
+        with compile_count_guard(expected_from_inventory(eng)):
+            eng.submit("hello")
+            eng.drain()
+
+
+def test_stale_inventory_expectation_fails_the_guard():
+    """The other drift direction: the manifest expecting MORE programs
+    than the engine compiles (a stale entry/domain) fails at guard exit."""
+    eng = make_engine()
+    eng.warmup()
+    expectation = expected_from_inventory(eng)
+    expectation.expected["_step"] += 1  # simulate a stale manifest claim
+    with pytest.raises(InventoryMismatchError, match="stale"):
+        with compile_count_guard(expectation):
+            pass
+
+
+def test_inventory_guard_rejects_unlisted_engines():
+    """expected_from_inventory only makes sense for engines whose warmup
+    promises full coverage; the bucketed engine compiles per live shape
+    by design and must be rejected loudly, not guarded wrongly."""
+    eng = TutoringEngine(EngineConfig(
+        model="tiny", sampling=SamplingParams.greedy(max_new_tokens=4),
+        length_buckets=(8,), batch_buckets=(1,), dtype=jnp.float32,
+    ))
+    with pytest.raises(InventoryMismatchError, match="warmup-covered"):
+        expected_from_inventory(eng)
