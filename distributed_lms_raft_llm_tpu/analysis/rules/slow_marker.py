@@ -6,6 +6,13 @@ hard timeout, so ONE unmarked soak blows the whole budget. Any test
 function whose name advertises a long-running shape (`soak`, `sustained`,
 `stress_many`) must be marked slow — directly, on its class, or via a
 module-level `pytestmark`.
+
+Semester-sim coverage: a `SimConfig(duration_s=N)` constructed in a test
+file runs a WALL-CLOCK workload of N seconds regardless of what the test
+is named, so any construction with a literal `duration_s` beyond
+`SIM_TIER1_DURATION_MAX_S` must sit inside a slow-marked function (or a
+slow-marked class/module) — the soak belongs to tier-2 whatever it calls
+itself.
 """
 
 from __future__ import annotations
@@ -18,6 +25,12 @@ from ..core import Finding, Rule, Source, register
 
 # Name fragments that mean "this test is a soak, not a unit test".
 SLOW_NAME_HINTS = ("soak", "sustained", "stress_many")
+
+# A sim workload longer than this is tier-2 by construction: the tier-1
+# semester sim budgets ~20-30 s of wall clock INCLUDING boot/settle/audit
+# around its (shorter) duration_s.
+SIM_TIER1_DURATION_MAX_S = 60.0
+_SIM_CONFIG_NAMES = ("SimConfig",)
 
 
 def _is_slow_mark(node: ast.expr) -> bool:
@@ -59,6 +72,33 @@ class SlowMarkerRule(Rule):
         findings: List[Finding] = []
         module_slow = _module_marked_slow(src.tree)
 
+        def check_sim_configs(node: ast.AST, slow: bool) -> None:
+            """Flag long-duration SimConfig literals outside slow scope."""
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                func = call.func
+                name = (func.id if isinstance(func, ast.Name)
+                        else func.attr if isinstance(func, ast.Attribute)
+                        else None)
+                if name not in _SIM_CONFIG_NAMES:
+                    continue
+                for kw in call.keywords:
+                    if kw.arg != "duration_s":
+                        continue
+                    v = kw.value
+                    if (isinstance(v, ast.Constant)
+                            and isinstance(v.value, (int, float))
+                            and v.value > SIM_TIER1_DURATION_MAX_S
+                            and not slow):
+                        findings.append(self.finding(
+                            src, call,
+                            f"SimConfig(duration_s={v.value}) runs a "
+                            f"{v.value}s wall-clock sim workload — more "
+                            f"than {SIM_TIER1_DURATION_MAX_S:.0f}s belongs "
+                            "under @pytest.mark.slow",
+                        ))
+
         def visit(body, class_slow: bool) -> None:
             for node in body:
                 if isinstance(node, ast.ClassDef):
@@ -67,20 +107,53 @@ class SlowMarkerRule(Rule):
                     )
                     visit(node.body, cls_slow)
                 elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn_slow = any(
+                        _is_slow_mark(d) for d in node.decorator_list
+                    )
+                    slow = fn_slow or class_slow or module_slow
+                    # Fixtures and helpers count too: whatever function
+                    # hosts the long sim, tier-1 pays its wall clock.
+                    check_sim_configs(node, slow)
                     if not node.name.startswith("test_"):
                         continue
                     hints = [h for h in SLOW_NAME_HINTS if h in node.name]
                     if not hints:
                         continue
-                    fn_slow = any(
-                        _is_slow_mark(d) for d in node.decorator_list
-                    )
-                    if not (fn_slow or class_slow or module_slow):
+                    if not slow:
                         findings.append(self.finding(
                             src, node,
                             f"{node.name} looks like a soak (name hints: "
                             f"{hints}) but lacks @pytest.mark.slow",
                         ))
+                else:
+                    # A compound statement (an `if HAVE_JAX:` guard, a
+                    # try/except import shim) can nest whole test
+                    # functions that carry their own decorators — recurse
+                    # into its blocks so those markers are read, instead
+                    # of blanket-walking through them.
+                    blocks = ("body", "orelse", "finalbody", "handlers")
+                    nested: List[ast.stmt] = []
+                    for field in ("body", "orelse", "finalbody"):
+                        nested.extend(getattr(node, field, None) or [])
+                    for handler in getattr(node, "handlers", None) or []:
+                        nested.extend(handler.body)
+                    if nested:
+                        visit(nested, class_slow)
+                        # Header expressions (an `if` test, `with` items)
+                        # are outside the blocks — scan them here.
+                        for field, value in ast.iter_fields(node):
+                            if field in blocks:
+                                continue
+                            for v in (value if isinstance(value, list)
+                                      else [value]):
+                                if isinstance(v, ast.AST):
+                                    check_sim_configs(
+                                        v, class_slow or module_slow
+                                    )
+                    else:
+                        # Simple statements (e.g. a shared config
+                        # constant) inherit the enclosing scope's mark.
+                        check_sim_configs(node, class_slow or module_slow)
 
         visit(src.tree.body, class_slow=False)
         return findings

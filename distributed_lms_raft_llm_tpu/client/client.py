@@ -83,6 +83,12 @@ class LMSClient:
         self.role: Optional[str] = None
         self._channels: Dict[str, grpc.Channel] = {}
         self._leader_addr: Optional[str] = None
+        # Leader addresses learned over the wire (GetLeader) that the boot
+        # list doesn't contain — a server added by a runtime membership
+        # change. Probed during discovery so the client can follow the
+        # cluster as it grows; `self.servers` stays the user's boot list
+        # (WhoIsLeader's positional id->address mapping depends on it).
+        self._extra_servers: List[str] = []
 
     # ------------------------------------------------------------ plumbing
 
@@ -102,18 +108,58 @@ class LMSClient:
             ch.close()
         self._channels.clear()
 
+    def _set_leader(self, addr: str) -> str:
+        self._leader_addr = addr
+        if addr not in self.servers and addr not in self._extra_servers:
+            # A leader the boot list doesn't know (membership-added node):
+            # remember it as a discovery peer of its own, so the client
+            # still finds the cluster if the boot-list nodes go away.
+            self._extra_servers.append(addr)
+        return addr
+
+    def evict_leader_hint(self, addr: Optional[str] = None) -> None:
+        """Drop the cached leader hint (all hints, or only `addr`). Called
+        when the hinted node fails an RPC — it may have been removed by a
+        membership change, restarted, or deposed — so the next op
+        re-discovers from any live peer instead of re-dialing a corpse.
+
+        A wire-learned (off-boot-list) address is also dropped from the
+        discovery peers: without this the list grows without bound under
+        membership churn and every sweep keeps probing removed nodes. If
+        the node is alive and leads again, the next GetLeader re-learns
+        it."""
+        if addr is None or self._leader_addr == addr:
+            self._leader_addr = None
+        if addr is not None and addr in self._extra_servers:
+            self._extra_servers.remove(addr)
+
     def discover_leader(
-        self, force: bool = False, deadline: Optional[Deadline] = None
+        self, force: bool = False, deadline: Optional[Deadline] = None,
+        avoid: Optional[str] = None,
     ) -> str:
         """Address of the current leader (cached until an RPC fails).
 
         Bounded by `deadline` when given: discovery gives up the moment the
         caller's budget is gone instead of finishing its sweep schedule.
+
+        `avoid` is an address that just failed an RPC (the evicted hint):
+        it is probed last, and during the first sweep a peer's report
+        naming it is treated as stale churn — other peers get the chance
+        to name the REAL leader first. If a full sweep produces nothing
+        else, the avoided address is accepted after all (the failure may
+        have been transient), so discovery degrades gracefully instead of
+        blacklisting a healthy node.
         """
         if self._leader_addr and not force:
             return self._leader_addr
         for attempt in range(self.discovery_rounds):
-            for addr in self.servers:
+            # Probe healthy candidates first; the just-failed node last.
+            order = [a for a in (*self.servers, *self._extra_servers)
+                     if a != avoid]
+            if avoid is not None:
+                order.append(avoid)
+            fallback: Optional[str] = None
+            for addr in order:
                 if deadline is not None and deadline.expired:
                     raise NoLeader(
                         f"no leader found among {self.servers} within budget"
@@ -127,14 +173,23 @@ class LMSClient:
                         lms_pb2.GetLeaderRequest(), timeout=probe_timeout
                     )
                     if resp.nodeId > 0 and resp.nodeAddress:
-                        self._leader_addr = resp.nodeAddress
-                        return self._leader_addr
+                        if resp.nodeAddress == avoid and attempt == 0:
+                            fallback = resp.nodeAddress
+                            continue
+                        return self._set_leader(resp.nodeAddress)
                     who = stub.WhoIsLeader(lms_pb2.Empty(), timeout=probe_timeout)
                     if 0 < who.leader_id <= len(self.servers):
-                        self._leader_addr = self.servers[who.leader_id - 1]
-                        return self._leader_addr
+                        cand = self.servers[who.leader_id - 1]
+                        if cand == avoid and attempt == 0:
+                            fallback = cand
+                            continue
+                        return self._set_leader(cand)
                 except grpc.RpcError:
                     continue
+            if fallback is not None:
+                # Every live peer still names the avoided address and a
+                # full sweep found no alternative: trust it after all.
+                return self._set_leader(fallback)
             sleep_s = jittered_backoff(
                 attempt, base_s=self.discovery_backoff_s,
                 cap_s=self.discovery_backoff_s * 4, rng=self._rng,
@@ -172,11 +227,14 @@ class LMSClient:
         # where generation legitimately outlasts control-plane RPCs).
         cap = self.rpc_timeout if attempt_cap_s == -1.0 else attempt_cap_s
         last_error: Optional[Exception] = None
+        avoid: Optional[str] = None
         for attempt in range(self.rpc_retries + 1):
             if deadline.expired:
                 break
+            addr = None
             try:
-                addr = self.discover_leader(force=attempt > 0, deadline=deadline)
+                addr = self.discover_leader(force=attempt > 0,
+                                            deadline=deadline, avoid=avoid)
                 stub = rpc.LMSStub(self._channel(addr))
                 timeout = max(0.001, deadline.timeout(cap=cap))
                 return fn(stub, timeout, deadline)
@@ -184,6 +242,14 @@ class LMSClient:
                 last_error = e
                 if e.code() not in RETRYABLE:
                     raise
+                if addr is not None:
+                    # Evict the hint and steer the next discovery sweep
+                    # away from the failed node: mid-churn (a membership
+                    # remove, a rolling restart) stale peers may keep
+                    # naming it, and re-trusting them first would pin every
+                    # retry on the same dead address.
+                    self.evict_leader_hint(addr)
+                    avoid = addr
                 log.info("rpc failed (%s); re-resolving leader", e.code())
                 if attempt >= self.rpc_retries:
                     break  # out of attempts: fail now, don't sleep first

@@ -172,6 +172,48 @@ class StorageConfig:
 
 
 @dataclasses.dataclass
+class SimConfig:
+    """[sim] — the semester simulator (sim/): one continuously-verified
+    production scenario composing the whole fault arsenal under SLOs.
+    Workload shape (students, diurnal curve), operations schedule, and the
+    SLO bounds the end-of-run checker asserts from `/metrics`/`/healthz`
+    all live here so a failed run replays from one seed + one section.
+    """
+
+    seed: int = 0                 # workload trace + event schedule RNG
+    students: int = 24
+    instructors: int = 2
+    courses: int = 3
+    duration_s: float = 30.0      # wall-clock length of the workload phase
+    base_rate: float = 8.0        # mean op arrival rate (ops/s)
+    diurnal_amplitude: float = 0.6  # 0 = flat load, 1 = full day/night swing
+    days: float = 1.0             # diurnal cycles compressed into the run
+    workers: int = 8              # client worker threads driving the trace
+    llm_budget_s: float = 10.0    # per-ask_llm overall client budget
+    tutoring_engine: str = "echo"  # "echo" (wire-complete stand-in) or
+    #                                "tiny" (real JAX engine, tier-2 soak)
+    events: bool = True           # run the operations schedule (transfer,
+    #                               quarantine, membership, chaos campaign)
+    slo_answer_p95_s: float = 6.0    # ask_llm p95 bound (client + /metrics)
+    slo_degraded_rate_max: float = 0.5  # degraded answers / llm requests
+    slo_tick_stalls_max: int = 50    # bound on summed raft_tick_stalls
+
+    def __post_init__(self) -> None:
+        if self.tutoring_engine not in ("echo", "tiny"):
+            raise ValueError(
+                f"[sim] tutoring_engine must be 'echo' or 'tiny', "
+                f"got {self.tutoring_engine!r}"
+            )
+        if self.students < 1 or self.workers < 1 or self.duration_s <= 0:
+            raise ValueError("[sim] needs students/workers >= 1 and "
+                             "duration_s > 0")
+        if self.courses < 1 or self.instructors < 1:
+            raise ValueError("[sim] needs courses/instructors >= 1")
+        if self.base_rate <= 0:
+            raise ValueError("[sim] base_rate must be > 0")
+
+
+@dataclasses.dataclass
 class AppConfig:
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     tutoring: TutoringConfig = dataclasses.field(default_factory=TutoringConfig)
@@ -181,6 +223,7 @@ class AppConfig:
         default_factory=ResilienceConfig
     )
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    sim: SimConfig = dataclasses.field(default_factory=SimConfig)
 
     @property
     def client_servers(self) -> List[str]:
@@ -203,7 +246,7 @@ def load_config(path: str) -> AppConfig:
     with open(path, "rb") as fh:
         raw = tomllib.load(fh)
     unknown = set(raw) - {"cluster", "tutoring", "sampling", "gate",
-                          "resilience", "storage"}
+                          "resilience", "storage", "sim"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -224,6 +267,7 @@ def load_config(path: str) -> AppConfig:
                           "resilience"),
         storage=_build(StorageConfig, dict(raw.get("storage", {})),
                        "storage"),
+        sim=_build(SimConfig, dict(raw.get("sim", {})), "sim"),
     )
 
 

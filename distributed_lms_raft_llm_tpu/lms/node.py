@@ -95,6 +95,15 @@ class LMSNode:
         if recovering:
             log.warning("resuming interrupted storage recovery "
                         "(marker %s present)", self._recovery_marker)
+            # A crash between the WAL/snapshot renames and the blob-tree
+            # rename leaves the (possibly bit-flipped) blobs live while
+            # the log loads clean — the corruption handler below never
+            # runs, and the healed node would serve corrupt blob bytes.
+            # The marker makes the quarantine idempotent: every
+            # marker-resume boot re-quarantines the blob tree (already
+            # -healed blobs re-fetch on miss).
+            self._quarantine_blob_tree(data_dir)
+            fs.fsync_dir(os.path.abspath(data_dir))
         try:
             self.snapshots = SnapshotStore(snap_path, fs=fs, metrics=metrics)
             self.state, applied = self.snapshots.load()
@@ -126,6 +135,8 @@ class LMSNode:
                     # handle to fsync; the dir fsync below persists the
                     # swap.  # lint: disable-next=durable-rename
                     fs.replace(path, path + ".corrupt")
+            # The blob tree shares the fate of the WAL.
+            self._quarantine_blob_tree(data_dir)
             fs.fsync_dir(os.path.abspath(data_dir))
             recovering = True
             self.snapshots = SnapshotStore(snap_path, fs=fs, metrics=metrics)
@@ -193,6 +204,29 @@ class LMSNode:
         return self.node.core.recovering
 
     # ------------------------------------------------------------ internals
+
+    def _quarantine_blob_tree(self, data_dir: str) -> None:
+        """Rename the blob tree aside and mount a fresh, empty one.
+
+        Blobs carry no integrity headers, so whatever corrupted the log
+        may have silently flipped blob bytes too — a recovering node must
+        not serve them. Quarantined blobs heal via fetch-on-miss once the
+        metadata re-replicates (a quorum of healthy peers holds every
+        acked upload)."""
+        fs = self._fs
+        uploads_dir = os.path.join(data_dir, "uploads")
+        if not fs.exists(uploads_dir):
+            return
+        dst, n = uploads_dir + ".corrupt", 0
+        while fs.exists(dst):  # dir renames don't overwrite
+            n += 1
+            dst = f"{uploads_dir}.corrupt.{n}"
+        # Quarantine, not an atomic write: the sources are closed,
+        # already-(un)durable files — there is no open handle to fsync;
+        # the caller's dir fsync persists the swap.
+        # lint: disable-next=durable-rename
+        fs.replace(uploads_dir, dst)
+        self.blobs = BlobStore(uploads_dir, fs=fs, metrics=self.metrics)
 
     def _on_recovered(self) -> None:
         log.info("storage recovery complete: log caught up to the "

@@ -117,6 +117,22 @@ class LMSServicer(rpc.LMSServicer):
             return None
         return username, self.state.role_of(username)
 
+    async def _auth_fenced(self, token: str, context):
+        """`_auth`, but a miss is re-checked behind the read fence.
+
+        A token miss on a freshly-elected leader can be apply lag, not an
+        invalid session: the Login entry is committed in its log but not
+        yet applied (the window right after a TimeoutNow transfer — the
+        new leader serves before its own-term no-op commits). Fence and
+        re-check before telling the client its session is gone; on a
+        non-leader the fence aborts UNAVAILABLE so the client re-resolves
+        instead. The valid-token fast path pays nothing."""
+        auth = self._auth(token)
+        if auth is not None:
+            return auth
+        await self._read_fence(context)
+        return self._auth(token)
+
     async def _propose(self, op: str, args: dict, context) -> bool:
         """Propose and await commit. Not-leader/timeout conditions abort the
         RPC with UNAVAILABLE — which the reference client already treats as
@@ -351,7 +367,7 @@ class LMSServicer(rpc.LMSServicer):
         return lms_pb2.LoginResponse(success=True, token=token, role=role)
 
     async def Logout(self, request, context):
-        if self.state.user_of_token(request.token) is None:
+        if await self._auth_fenced(request.token, context) is None:
             return lms_pb2.LogoutResponse(success=False)
         ok = await self._propose("Logout", {"token": request.token}, context)
         return lms_pb2.LogoutResponse(success=ok)
@@ -359,7 +375,7 @@ class LMSServicer(rpc.LMSServicer):
     # --------------------------------------------------------------- writes
 
     async def Post(self, request, context):
-        auth = self._auth(request.token)
+        auth = await self._auth_fenced(request.token, context)
         if auth is None:
             return lms_pb2.PostResponse(success=False)
         username, role = auth
@@ -412,7 +428,7 @@ class LMSServicer(rpc.LMSServicer):
         return lms_pb2.PostResponse(success=False)
 
     async def GradeAssignment(self, request, context):
-        auth = self._auth(request.token)
+        auth = await self._auth_fenced(request.token, context)
         if auth is None:
             return lms_pb2.GradeResponse(
                 success=False, message="Invalid session token"
@@ -436,7 +452,7 @@ class LMSServicer(rpc.LMSServicer):
         return lms_pb2.GradeResponse(success=ok, message=msg)
 
     async def RespondToQuery(self, request, grpc_context):
-        auth = self._auth(request.token)
+        auth = await self._auth_fenced(request.token, grpc_context)
         if auth is None:
             return lms_pb2.PostResponse(success=False)
         username, role = auth

@@ -223,7 +223,13 @@ class RaftServicer(rpc.RaftServiceServicer):
     def __init__(self, node: RaftNode, addresses: Dict[int, str],
                  kv: Optional[dict] = None):
         self.node = node
-        self.addresses = dict(addresses)
+        # Held by REFERENCE, not copied: callers that pass a live map
+        # (serving/lms_server.py passes LMSNode.addresses, which runtime
+        # membership changes mutate) keep GetLeader truthful after a
+        # server is added or moved — a client must be able to learn a
+        # membership-added leader's address from ANY live peer, or its
+        # leader-hint re-discovery dead-ends on the boot topology.
+        self.addresses = addresses
         # Replicated KV escape hatch (SetVal/GetVal RPCs of the contract).
         self.kv: dict = kv if kv is not None else {}
 

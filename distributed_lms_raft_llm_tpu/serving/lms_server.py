@@ -36,7 +36,7 @@ from ..proto import rpc
 from ..raft import RaftConfig
 from ..raft.grpc_transport import RaftServicer
 from ..utils.diskfaults import DiskFaultInjector
-from ..utils.faults import FaultInjector
+from ..utils.faults import CampaignRunner, FaultInjector
 from ..utils.metrics import Metrics
 from ..utils.resilience import CircuitBreaker
 
@@ -53,6 +53,143 @@ def parse_addresses(peers, host: str) -> Dict[int, str]:
     for i, peer in enumerate(peers, start=1):
         addresses[i] = peer if ":" in peer else f"{host}:{peer}"
     return addresses
+
+
+def fault_state(faults: FaultInjector, disk_faults: DiskFaultInjector,
+                campaigns: CampaignRunner) -> Dict:
+    """The active fault/campaign configuration — ONE shape shared by
+    `POST /admin/faults` responses and `GET /admin/faults`, so operators
+    and the semester simulator assert against the same document."""
+    snap = faults.snapshot()
+    snap["disk"] = disk_faults.snapshot()
+    return {"ok": True, "faults": snap, "campaign": campaigns.snapshot()}
+
+
+def make_admin(lms_node: LMSNode, faults: FaultInjector,
+               disk_faults: DiskFaultInjector, campaigns: CampaignRunner):
+    """The node's admin plane: (POST handler, GET handler) for the local
+    HTTP endpoint (utils/healthz.py). Module-level (not inlined in
+    serve_async) so the in-process semester-sim cluster (sim/cluster.py)
+    serves the EXACT operator surface the production entrypoint serves."""
+
+    async def admin(path: str, body: Dict) -> Dict:
+        """POST /admin/membership {"op": "add"|"remove", "id": N,
+        "address": "host:port"} — single-server Raft membership change on
+        the leader (raft/core.py §4 machinery).
+        POST /admin/transfer {"target": N?} — graceful leadership handoff
+        (thesis §3.10: drain to the most caught-up member before planned
+        maintenance; resolves once this node has stepped down).
+        POST /admin/faults — chaos over real gRPC (utils/faults.py):
+        {"target": "raft:2"|"tutoring"|"*", "drop": 0.3, "error": 0.1,
+        "delay_s": 0.05, "delay_jitter_s": 0.05, "duplicate": 0.1} installs
+        a spec; target "disk" routes to the storage-plane injector
+        (utils/diskfaults.py: {"target": "disk", "write_error": 0.05,
+        "fsync_error": 0.02, "bit_flip": 0.01}); {"clear": "raft:2"} (or
+        "disk") removes one; {"reset": true} removes all (and cancels any
+        campaign); {"campaign": {"name": "...", "phases": [{"target": ...,
+        "duration_s": 2.0, ...spec}]}} schedules a timed campaign
+        (utils/faults.CampaignRunner); {"campaign_cancel": true} stops it;
+        {} reads the current state (also served read-only as
+        GET /admin/faults).
+        The admin plane rides the local HTTP endpoint, keeping the gRPC
+        wire contract frozen."""
+        if path == "/admin/faults":
+            if body.get("reset"):
+                # stop(), not cancel(): the response snapshot below must
+                # not race the cancelled campaign's finally-clear and
+                # show its spec as still installed.
+                await campaigns.stop()
+                faults.clear()
+                disk_faults.clear()
+            elif body.get("campaign_cancel"):
+                await campaigns.stop()
+            elif "campaign" in body:
+                camp = body["campaign"]
+                if not isinstance(camp, dict) or "phases" not in camp:
+                    raise ValueError(
+                        "campaign needs {'name': ..., 'phases': [...]}"
+                    )
+                campaigns.start(str(camp.get("name", "campaign")),
+                                list(camp["phases"]))
+            elif "clear" in body:
+                if str(body["clear"]) == "disk":
+                    disk_faults.clear()
+                else:
+                    faults.clear(str(body["clear"]))
+            elif "target" in body:
+                spec = {k: v for k, v in body.items() if k != "target"}
+                if str(body["target"]) == "disk":
+                    disk_faults.configure(**spec)
+                else:
+                    faults.configure(str(body["target"]), **spec)
+            return fault_state(faults, disk_faults, campaigns)
+        if path == "/admin/transfer":
+            target = body.get("target")
+            chosen = await lms_node.node.transfer_leadership(
+                None if target is None else int(target)
+            )
+            # No leader_id here: this node just abdicated, and its local
+            # view stays stale until the new leader's first append — the
+            # target IS the expected leader; clients re-resolve as usual.
+            return {"ok": True, "target": chosen}
+        if path != "/admin/membership":
+            raise KeyError(path)
+        op = body.get("op")
+        if op not in ("add", "remove"):
+            raise ValueError("op must be 'add' or 'remove'")
+        if "id" not in body:
+            raise ValueError("missing 'id'")
+        nid = int(body["id"])
+        if op == "add" and "address" not in body:
+            raise ValueError("'add' requires 'address'")
+        members = {
+            k: lms_node.addresses.get(k, v)
+            for k, v in lms_node.node.core.members.items()
+        }
+        if op == "add":
+            members[nid] = str(body["address"])
+        else:
+            members.pop(nid, None)
+        index = await lms_node.node.propose_config(members)
+        return {"ok": True, "index": index,
+                "members": {str(k): v for k, v in members.items()}}
+
+    async def admin_get(path: str) -> Dict:
+        """GET /admin/faults — read-only introspection of the active
+        fault/campaign configuration. The plane used to be write-only:
+        an operator (or the semester sim's auditor) could INSTALL chaos
+        but never assert what was currently injected."""
+        if path != "/admin/faults":
+            raise KeyError(path)
+        return fault_state(faults, disk_faults, campaigns)
+
+    return admin, admin_get
+
+
+def make_health(node_id: int, lms_node: LMSNode, breaker: CircuitBreaker,
+                faults: FaultInjector):
+    """/healthz provider closure (shared with sim/cluster.py)."""
+
+    def health() -> Dict:
+        return {
+            "ok": True,
+            "node_id": node_id,
+            "role": "leader" if lms_node.node.is_leader else "follower",
+            "leader_id": lms_node.node.leader_id,
+            "applied_index": lms_node.node.core.last_applied,
+            "members": {
+                str(k): v for k, v in lms_node.node.core.members.items()
+            },
+            # Resilience surface: operators see shed/degrade pressure
+            # here without scraping /metrics.
+            "tutoring_breaker": breaker.snapshot(),
+            "faults": faults.snapshot(),
+            # Storage-recovery surface: true while this node discarded
+            # corrupt local state and is re-syncing from the leader.
+            "storage_recovering": lms_node.recovering,
+        }
+
+    return health
 
 
 async def serve_async(args) -> None:
@@ -142,7 +279,11 @@ async def serve_async(args) -> None:
     )
     rpc.add_LMSServicer_to_server(servicer, server)
     rpc.add_RaftServiceServicer_to_server(
-        RaftServicer(lms_node.node, addresses, kv=lms_node.state.data["kv"]),
+        # The LIVE address map (membership changes mutate it): GetLeader
+        # must report a membership-added leader's address, or clients
+        # could never re-discover it from this peer.
+        RaftServicer(lms_node.node, lms_node.addresses,
+                     kv=lms_node.state.data["kv"]),
         server,
     )
     rpc.add_FileTransferServiceServicer_to_server(
@@ -151,71 +292,8 @@ async def serve_async(args) -> None:
     server.add_insecure_port(f"[::]:{args.port}")
     await server.start()
     await lms_node.start()
-    async def admin(path: str, body: Dict) -> Dict:
-        """POST /admin/membership {"op": "add"|"remove", "id": N,
-        "address": "host:port"} — single-server Raft membership change on
-        the leader (raft/core.py §4 machinery).
-        POST /admin/transfer {"target": N?} — graceful leadership handoff
-        (thesis §3.10: drain to the most caught-up member before planned
-        maintenance; resolves once this node has stepped down).
-        POST /admin/faults — chaos over real gRPC (utils/faults.py):
-        {"target": "raft:2"|"tutoring"|"*", "drop": 0.3, "error": 0.1,
-        "delay_s": 0.05, "delay_jitter_s": 0.05, "duplicate": 0.1} installs
-        a spec; target "disk" routes to the storage-plane injector
-        (utils/diskfaults.py: {"target": "disk", "write_error": 0.05,
-        "fsync_error": 0.02, "bit_flip": 0.01}); {"clear": "raft:2"} (or
-        "disk") removes one; {"reset": true} removes all; {} reads the
-        current state.
-        The admin plane rides the local HTTP endpoint, keeping the gRPC
-        wire contract frozen."""
-        if path == "/admin/faults":
-            if body.get("reset"):
-                faults.clear()
-                disk_faults.clear()
-            elif "clear" in body:
-                if str(body["clear"]) == "disk":
-                    disk_faults.clear()
-                else:
-                    faults.clear(str(body["clear"]))
-            elif "target" in body:
-                spec = {k: v for k, v in body.items() if k != "target"}
-                if str(body["target"]) == "disk":
-                    disk_faults.configure(**spec)
-                else:
-                    faults.configure(str(body["target"]), **spec)
-            snap = faults.snapshot()
-            snap["disk"] = disk_faults.snapshot()
-            return {"ok": True, "faults": snap}
-        if path == "/admin/transfer":
-            target = body.get("target")
-            chosen = await lms_node.node.transfer_leadership(
-                None if target is None else int(target)
-            )
-            # No leader_id here: this node just abdicated, and its local
-            # view stays stale until the new leader's first append — the
-            # target IS the expected leader; clients re-resolve as usual.
-            return {"ok": True, "target": chosen}
-        if path != "/admin/membership":
-            raise KeyError(path)
-        op = body.get("op")
-        if op not in ("add", "remove"):
-            raise ValueError("op must be 'add' or 'remove'")
-        if "id" not in body:
-            raise ValueError("missing 'id'")
-        nid = int(body["id"])
-        if op == "add" and "address" not in body:
-            raise ValueError("'add' requires 'address'")
-        members = {
-            k: lms_node.addresses.get(k, v)
-            for k, v in lms_node.node.core.members.items()
-        }
-        if op == "add":
-            members[nid] = str(body["address"])
-        else:
-            members.pop(nid, None)
-        index = await lms_node.node.propose_config(members)
-        return {"ok": True, "index": index,
-                "members": {str(k): v for k, v in members.items()}}
+    campaigns = CampaignRunner(faults, disk_faults, metrics=metrics)
+    admin, admin_get = make_admin(lms_node, faults, disk_faults, campaigns)
 
     health = None
     if args.metrics_port is not None:
@@ -223,24 +301,9 @@ async def serve_async(args) -> None:
 
         health = HealthServer(
             metrics,
-            health=lambda: {
-                "ok": True,
-                "node_id": args.id,
-                "role": "leader" if lms_node.node.is_leader else "follower",
-                "leader_id": lms_node.node.leader_id,
-                "applied_index": lms_node.node.core.last_applied,
-                "members": {
-                    str(k): v for k, v in lms_node.node.core.members.items()
-                },
-                # Resilience surface: operators see shed/degrade pressure
-                # here without scraping /metrics.
-                "tutoring_breaker": breaker.snapshot(),
-                "faults": faults.snapshot(),
-                # Storage-recovery surface: true while this node discarded
-                # corrupt local state and is re-syncing from the leader.
-                "storage_recovering": lms_node.recovering,
-            },
+            health=make_health(args.id, lms_node, breaker, faults),
             admin=admin,
+            admin_get=admin_get,
             port=args.metrics_port,
         )
         bound = await health.start()
@@ -258,6 +321,7 @@ async def serve_async(args) -> None:
         await server.wait_for_termination()
     finally:
         reporter.cancel()
+        campaigns.cancel()
         if health is not None:
             await health.stop()
         await lms_node.stop()

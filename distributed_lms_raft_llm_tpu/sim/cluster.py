@@ -1,0 +1,440 @@
+"""The cluster under test: real gRPC nodes with the real admin plane.
+
+Boots N LMS nodes (Raft + LMS + FileTransfer servicers, per-node fault
+injectors, breaker, and the SAME admin/health plane `serving/lms_server`
+serves — `make_admin`/`make_health` are imported, not re-implemented) plus
+a tutoring node, all on one background asyncio loop, with thread-safe
+control methods for the workload workers and the operations scheduler:
+restart a node in place (same port, same data dir — the storage-recovery
+path runs for real), spawn an extra node for a membership add, scrape
+`/metrics`, and drive `POST`/`GET /admin/*` over actual HTTP.
+
+Ports are allocated once and pinned for the cluster's lifetime so a
+restarted node comes back at its advertised address (peers re-dial it,
+clients re-discover it).
+
+The default tutoring engine is `EchoEngine` — a wire-complete stand-in
+that exercises the REAL BatchingQueue admission, deadline shedding, HMAC
+path, and gRPC plumbing without paying an XLA compile; the tier-2 soak
+swaps in the real tiny JAX engine (`[sim] tutoring_engine = "tiny"`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+import grpc
+
+from ..config import SimConfig
+from ..lms.node import LMSNode
+from ..lms.service import FileTransferServicer, LMSServicer
+from ..proto import rpc
+from ..raft import RaftConfig
+from ..raft.grpc_transport import RaftServicer
+from ..serving.lms_server import make_admin, make_health
+from ..serving.tutoring_server import TutoringService
+from ..utils.diskfaults import DiskFaultInjector
+from ..utils.faults import CampaignRunner, FaultInjector
+from ..utils.healthz import HealthServer
+from ..utils.metrics import Metrics
+from ..utils.resilience import CircuitBreaker
+
+log = logging.getLogger(__name__)
+
+# Sim Raft timing: fast elections so transfers/restarts resolve in tens of
+# milliseconds, aggressive snapshotting so the quarantine rejoin really
+# exercises InstallSnapshot (the leader compacts the prefix away).
+SIM_RAFT = RaftConfig(
+    election_timeout_min=0.15, election_timeout_max=0.30,
+    heartbeat_interval=0.05,
+)
+SIM_SNAPSHOT_EVERY = 8
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class EchoEngine:
+    """Deterministic tutoring stand-in with the `answer_batch` contract.
+
+    A tiny sleep gives the latency histograms a real (but bounded)
+    distribution; it runs in the batcher's executor, never on the loop.
+    """
+
+    def __init__(self, delay_s: float = 0.002):
+        self.delay_s = delay_s
+
+    def answer_batch(self, prompts: List[str]) -> List[str]:
+        time.sleep(self.delay_s)
+        return [f"Echo tutor: {p.splitlines()[-2][:96]}"
+                if len(p.splitlines()) >= 2 else f"Echo tutor: {p[:96]}"
+                for p in prompts]
+
+
+class KeywordGate:
+    """Deterministic `RelevanceGate` stand-in with the same `check`
+    contract — `(passes, similarity)` from query vs. assignment text.
+
+    Token overlap (stopwords dropped, 4-char-prefix stemming) instead of
+    BERT embeddings, so the workload's off-topic asks really exercise the
+    gate-reject path and the `gate_pass`/`gate_reject` counters without
+    paying an XLA compile. The workload's on-topic queries score >= 0.2
+    against its assignment text and the off-topic ones score 0.0, so the
+    threshold splits them with margin on both sides.
+    """
+
+    threshold = 0.1
+
+    _STOPWORDS = frozenset(
+        "the a an is are was of for to and or in on at by me my what how "
+        "why who when where does do did it that this after under about "
+        "with please i you we your".split()
+    )
+
+    def _words(self, text: str) -> set:
+        return {
+            w for w in (t.strip(".,?!:;-'\"()").lower()
+                        for t in text.split())
+            if w and w not in self._STOPWORDS
+        }
+
+    def check(self, query: str, context: str) -> tuple:
+        q, c = self._words(query), self._words(context)
+        if not q:
+            return False, 0.0
+        hits = sum(
+            1 for w in q
+            if w in c or (len(w) >= 4 and any(
+                len(cw) >= 4 and cw[:4] == w[:4] for cw in c
+            ))
+        )
+        sim = hits / len(q)
+        return sim >= self.threshold, sim
+
+
+class SimCluster:
+    def __init__(self, workdir: str, cfg: SimConfig, *, nodes: int = 3):
+        self.workdir = workdir
+        self.cfg = cfg
+        self.n_base = nodes
+        self._loop = asyncio.new_event_loop()
+        self._thread: Optional[threading.Thread] = None
+        self._nodes: Dict[int, Dict] = {}       # guarded-by: _lock
+        self._ports: Dict[int, int] = {}        # guarded-by: _lock
+        self._health_ports: Dict[int, int] = {}  # guarded-by: _lock
+        self._addresses: Dict[int, str] = {}    # guarded-by: _lock
+        self._extra: Optional[int] = None       # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._tutoring: Dict = {}
+        self._tutoring_addr: Optional[str] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        with self._lock:
+            for nid in range(1, self.n_base + 1):
+                self._ports[nid] = _free_port()
+                self._health_ports[nid] = _free_port()
+                self._addresses[nid] = f"127.0.0.1:{self._ports[nid]}"
+        self._thread = threading.Thread(
+            target=self._loop_main, name="sim-cluster", daemon=True
+        )
+        self._thread.start()
+        self._run(self._boot_tutoring(), timeout=120.0)
+        for nid in range(1, self.n_base + 1):
+            self._run(self._boot_node(nid), timeout=60.0)
+        if self.wait_leader(timeout=20.0) is None:
+            raise RuntimeError("sim cluster elected no leader")
+
+    def stop(self) -> None:
+        for nid in list(self._nodes):
+            try:
+                self._run(self._stop_node(nid), timeout=30.0)
+            except Exception:
+                log.exception("stopping sim node %d failed", nid)
+        try:
+            self._run(self._stop_tutoring(), timeout=30.0)
+        except Exception:
+            log.exception("stopping sim tutoring failed")
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def _loop_main(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+
+    def _run(self, coro, timeout: float):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
+            timeout
+        )
+
+    # ------------------------------------------------------------- topology
+
+    def node_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def client_servers(self) -> List[str]:
+        with self._lock:
+            return [self._addresses[n] for n in sorted(self._addresses)
+                    if n <= self.n_base]
+
+    def extra_node_id(self) -> Optional[int]:
+        with self._lock:
+            return self._extra
+
+    def health_port(self, nid: int) -> int:
+        with self._lock:
+            return self._health_ports[nid]
+
+    # -------------------------------------------------------- node control
+
+    def restart_node(self, nid: int) -> None:
+        self._run(self._stop_node(nid), timeout=30.0)
+        self._run(self._boot_node(nid), timeout=60.0)
+
+    def stop_node(self, nid: int) -> None:
+        self._run(self._stop_node(nid), timeout=30.0)
+
+    def spawn_extra_node(self) -> tuple:
+        """Boot one more node (fresh storage) for a membership add; it
+        campaigns harmlessly until the leader commits the config entry
+        (the §4.2.3 vote guard keeps it from disrupting the members)."""
+        with self._lock:
+            nid = max(self._ports) + 1
+            self._ports[nid] = _free_port()
+            self._health_ports[nid] = _free_port()
+            self._addresses[nid] = f"127.0.0.1:{self._ports[nid]}"
+            self._extra = nid
+        self._run(self._boot_node(nid), timeout=60.0)
+        return nid, self._addresses[nid]
+
+    # ----------------------------------------------------------- HTTP plane
+
+    def _http(self, req: urllib.request.Request, timeout: float = 10.0):
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    def admin_post(self, nid: int, path: str, body: Dict) -> Dict:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.health_port(nid)}{path}",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            return self._http(req, timeout=30.0)
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(
+                f"admin POST {path} on node {nid} -> {e.code}: {detail}"
+            ) from e
+
+    def admin_get(self, nid: int, path: str) -> Dict:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.health_port(nid)}{path}", method="GET"
+        )
+        return self._http(req)
+
+    def healthz(self, nid: int) -> Dict:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.health_port(nid)}/healthz", method="GET"
+        )
+        return self._http(req)
+
+    def metrics_snapshot(self, nid: int) -> Dict:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.health_port(nid)}/metrics", method="GET"
+        )
+        return self._http(req)
+
+    def scrape_all(self) -> tuple:
+        """({nid: /metrics}, {nid: /healthz}) for every live node."""
+        metrics, health = {}, {}
+        for nid in self.node_ids():
+            try:
+                metrics[nid] = self.metrics_snapshot(nid)
+                health[nid] = self.healthz(nid)
+            except (urllib.error.URLError, OSError) as e:
+                raise RuntimeError(
+                    f"node {nid} unreachable during final scrape: {e}"
+                ) from e
+        return metrics, health
+
+    # --------------------------------------------------------------- waits
+
+    def wait_leader(self, timeout: float,
+                    exclude: Optional[int] = None) -> Optional[int]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for nid in self.node_ids():
+                if nid == exclude:
+                    continue
+                try:
+                    h = self.healthz(nid)
+                except (urllib.error.URLError, OSError):
+                    continue
+                if h.get("role") == "leader" and not h.get(
+                    "storage_recovering"
+                ):
+                    return nid
+            time.sleep(0.05)
+        return None
+
+    def wait_healthy(self, nid: int, timeout: float) -> Dict:
+        deadline = time.monotonic() + timeout
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                h = self.healthz(nid)
+                if h.get("ok"):
+                    return h
+            except (urllib.error.URLError, OSError) as e:
+                last = e
+            time.sleep(0.05)
+        raise TimeoutError(f"node {nid} not healthy in {timeout}s ({last})")
+
+    def wait_until(self, nid: int, pred: Callable[[Dict], bool],
+                   timeout: float, what: str) -> Dict:
+        deadline = time.monotonic() + timeout
+        h: Dict = {}
+        while time.monotonic() < deadline:
+            try:
+                h = self.healthz(nid)
+                if pred(h):
+                    return h
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.05)
+        raise TimeoutError(f"node {nid}: timed out waiting for {what} "
+                           f"(last healthz: {h})")
+
+    # ------------------------------------------------------------ coroutines
+
+    async def _boot_tutoring(self) -> None:
+        from ..engine import BatchingQueue
+
+        if self.cfg.tutoring_engine == "tiny":
+            import jax
+
+            from ..engine import EngineConfig, SamplingParams, TutoringEngine
+
+            engine = TutoringEngine(EngineConfig(
+                model="tiny",
+                sampling=SamplingParams(max_new_tokens=8),
+                length_buckets=(32,), batch_buckets=(1, 2, 4),
+                dtype=jax.numpy.float32,
+            ))
+            # Compile now, while this loop runs nothing else: tutoring
+            # boots BEFORE the Raft nodes, so the XLA compile can't stall
+            # their tick loops (every node shares this loop+GIL).
+            engine.warmup(batch=4)
+        else:
+            engine = EchoEngine()
+        metrics = Metrics()
+        queue = BatchingQueue(engine, max_batch=4, max_wait_ms=5.0,
+                              metrics=metrics, max_queue=64)
+        await queue.start()
+        server = grpc.aio.server()
+        rpc.add_TutoringServicer_to_server(
+            TutoringService(queue, metrics), server
+        )
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        self._tutoring = {"server": server, "queue": queue,
+                          "metrics": metrics}
+        self._tutoring_addr = f"127.0.0.1:{port}"
+
+    async def _stop_tutoring(self) -> None:
+        if not self._tutoring:
+            return
+        await self._tutoring["server"].stop(None)
+        await self._tutoring["queue"].close()
+        self._tutoring = {}
+
+    async def _boot_node(self, nid: int) -> None:
+        cfg = self.cfg
+        with self._lock:
+            addresses = dict(self._addresses)
+            port = self._ports[nid]
+        faults = FaultInjector(seed=cfg.seed * 1000 + nid)
+        disk_faults = DiskFaultInjector(seed=cfg.seed * 1000 + nid)
+        metrics = Metrics()
+        breaker = CircuitBreaker(failure_threshold=3, recovery_s=0.5)
+        lms_node = LMSNode(
+            nid, addresses, f"{self.workdir}/node{nid}",
+            raft_config=SIM_RAFT, snapshot_every=SIM_SNAPSHOT_EVERY,
+            fault_injector=faults, disk_fault_injector=disk_faults,
+            metrics=metrics,
+        )
+        servicer = LMSServicer(
+            lms_node.node, lms_node.state, lms_node.blobs,
+            gate=KeywordGate(),
+            tutoring_address=self._tutoring_addr,
+            metrics=metrics,
+            peer_addresses=lms_node.addresses,
+            self_id=nid,
+            tutoring_breaker=breaker,
+            fault_injector=faults,
+            tutoring_timeout_s=min(30.0, cfg.llm_budget_s),
+            deadline_floor_s=0.25,
+        )
+        server = grpc.aio.server(
+            options=[("grpc.max_receive_message_length", 50 * 1024 * 1024)]
+        )
+        rpc.add_LMSServicer_to_server(servicer, server)
+        rpc.add_RaftServiceServicer_to_server(
+            # Live map: membership-added peers must be reported by
+            # GetLeader (client leader-hint re-discovery depends on it).
+            RaftServicer(lms_node.node, lms_node.addresses,
+                         kv=lms_node.state.data["kv"]),
+            server,
+        )
+        rpc.add_FileTransferServiceServicer_to_server(
+            FileTransferServicer(lms_node.blobs), server
+        )
+        bound = server.add_insecure_port(f"127.0.0.1:{port}")
+        if bound != port:
+            raise RuntimeError(f"node {nid}: wanted port {port}, got {bound}")
+        await server.start()
+        await lms_node.start()
+        campaigns = CampaignRunner(faults, disk_faults, metrics=metrics)
+        admin, admin_get = make_admin(lms_node, faults, disk_faults,
+                                      campaigns)
+        health = HealthServer(
+            metrics,
+            health=make_health(nid, lms_node, breaker, faults),
+            admin=admin, admin_get=admin_get,
+            port=self._health_ports[nid],
+        )
+        await health.start()
+        with self._lock:
+            self._nodes[nid] = {
+                "lms_node": lms_node, "server": server, "health": health,
+                "faults": faults, "disk_faults": disk_faults,
+                "campaigns": campaigns, "metrics": metrics,
+                "breaker": breaker,
+            }
+
+    async def _stop_node(self, nid: int) -> None:
+        with self._lock:
+            rec = self._nodes.pop(nid, None)
+        if rec is None:
+            return
+        rec["campaigns"].cancel()
+        await rec["health"].stop()
+        await rec["lms_node"].stop()
+        await rec["server"].stop(None)

@@ -1,0 +1,361 @@
+"""The operations schedule: planned and unplanned events injected mid-run.
+
+`plan_events` is — like the workload trace — a pure function of the
+config: the schedule (what happens, when, with which parameters) comes
+from the seed, so a failed run replays. `OperationsScheduler` executes
+the plan against a live `SimCluster` strictly through the surfaces real
+operators use: `POST /admin/faults` (including timed campaigns),
+`POST /admin/transfer` (TimeoutNow leadership handoff), the disk-fault
+admin plane for the storage-recovery quarantine, and
+`POST /admin/membership` for the add/remove — then verifies each event's
+observable outcome from `/healthz` (`GET /admin/faults` for campaigns).
+
+Each event records an outcome dict; any `ok=False` outcome fails the
+run's verdict (the harness feeds `failures()` into `evaluate_slos` as
+the `events_completed` check), so the acceptance criteria —
+>=1 transfer, >=1 quarantine+rejoin, >=1 membership change — are proven,
+not assumed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..config import SimConfig
+from ..utils import metrics_registry as metric
+
+log = logging.getLogger(__name__)
+
+CHAOS_CAMPAIGN = "chaos_campaign"
+ROLLING_RESTART = "rolling_restart"
+QUARANTINE = "quarantine"
+MEMBERSHIP_ADD = "membership_add"
+MEMBERSHIP_REMOVE = "membership_remove"
+
+
+@dataclasses.dataclass(frozen=True)
+class SimEvent:
+    at_s: float      # offset from workload start
+    kind: str
+    params: Dict[str, float]
+
+    def key(self) -> str:
+        items = ",".join(f"{k}={v}" for k, v in sorted(self.params.items()))
+        return f"{self.at_s:.6f}|{self.kind}|{items}"
+
+
+def _jitter(rng: random.Random, frac: float, width: float) -> float:
+    return frac + rng.uniform(-width, width)
+
+
+def plan_events(cfg: SimConfig) -> List[SimEvent]:
+    """The semester's operations calendar, scaled to `duration_s`.
+
+    Layout (fractions of the run, seed-jittered): an early network-chaos
+    campaign whose last phase blacks out the tutoring hop (degraded
+    answers, breaker open/close), a rolling restart of the leader via
+    TimeoutNow transfer, a follower quarantined into storage recovery via
+    disk bit flips, then a membership add and the matching remove.
+    """
+    if not cfg.events:
+        return []
+    rng = random.Random(cfg.seed ^ 0x5EED)
+    T = cfg.duration_s
+    chaos_hold = max(1.0, 0.10 * T)
+    outage_hold = max(0.8, 0.07 * T)
+    return [
+        SimEvent(
+            at_s=_jitter(rng, 0.12, 0.02) * T, kind=CHAOS_CAMPAIGN,
+            params={
+                "drop": 0.10, "delay_s": 0.002, "delay_jitter_s": 0.01,
+                "duplicate": 0.05, "hold_s": round(chaos_hold, 3),
+                "outage_hold_s": round(outage_hold, 3),
+            },
+        ),
+        SimEvent(at_s=_jitter(rng, 0.38, 0.02) * T, kind=ROLLING_RESTART,
+                 params={}),
+        SimEvent(
+            at_s=_jitter(rng, 0.55, 0.02) * T, kind=QUARANTINE,
+            params={"burst_s": round(max(0.8, 0.05 * T), 3),
+                    "settle_s": round(max(0.6, 0.03 * T), 3)},
+        ),
+        SimEvent(at_s=_jitter(rng, 0.75, 0.02) * T, kind=MEMBERSHIP_ADD,
+                 params={}),
+        SimEvent(at_s=_jitter(rng, 0.90, 0.02) * T, kind=MEMBERSHIP_REMOVE,
+                 params={}),
+    ]
+
+
+class OperationsScheduler:
+    """Executes a plan against a `SimCluster` on its own thread.
+
+    `writer` is a callable issuing one guaranteed acked write (the
+    harness's ops-bot client): the quarantine event uses it to make sure
+    corrupted-on-disk records actually exist during the bit-flip burst
+    even if the diurnal trough goes quiet, and clean records land after
+    it (mid-file corruption, not a truncatable torn tail).
+    """
+
+    def __init__(self, cluster, plan: List[SimEvent], *, metrics=None,
+                 writer=None, asker=None):
+        self.cluster = cluster
+        self.plan = sorted(plan, key=lambda e: e.at_s)
+        self.metrics = metrics
+        self.writer = writer
+        self.asker = asker
+        self.outcomes: List[Dict] = []   # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- control
+
+    def start(self, t0: float) -> None:
+        self._thread = threading.Thread(
+            target=self._run, args=(t0,), name="sim-ops", daemon=True
+        )
+        self._thread.start()
+
+    def join(self, timeout: float) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("operations scheduler did not finish")
+
+    def executed_kinds(self) -> Dict[str, int]:
+        with self._lock:
+            kinds: Dict[str, int] = {}
+            for o in self.outcomes:
+                if o["ok"]:
+                    kinds[o["kind"]] = kinds.get(o["kind"], 0) + 1
+            return kinds
+
+    def failures(self) -> List[Dict]:
+        with self._lock:
+            return [o for o in self.outcomes if not o["ok"]]
+
+    # ------------------------------------------------------------ internals
+
+    def _run(self, t0: float) -> None:
+        for event in self.plan:
+            delay = t0 + event.at_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            outcome = {"kind": event.kind, "at_s": round(event.at_s, 3),
+                       "ok": False, "detail": ""}
+            try:
+                handler = {
+                    CHAOS_CAMPAIGN: self._chaos_campaign,
+                    ROLLING_RESTART: self._rolling_restart,
+                    QUARANTINE: self._quarantine,
+                    MEMBERSHIP_ADD: self._membership_add,
+                    MEMBERSHIP_REMOVE: self._membership_remove,
+                }[event.kind]
+                outcome["detail"] = handler(event)
+                outcome["ok"] = True
+                if self.metrics is not None:
+                    self.metrics.inc(metric.SIM_EVENTS_INJECTED)
+            except Exception as e:  # recorded; the harness fails the run
+                log.exception("sim event %s failed", event.kind)
+                outcome["detail"] = f"{type(e).__name__}: {e}"
+            with self._lock:
+                self.outcomes.append(outcome)
+
+    def _leader(self) -> int:
+        nid = self.cluster.wait_leader(timeout=15.0)
+        if nid is None:
+            raise RuntimeError("no leader to operate on")
+        return nid
+
+    def _post_leader(self, path: str, body: Dict, *,
+                     attempts: int = 4,
+                     avoid: Optional[int] = None) -> Dict:
+        """POST an admin op that must land on the live leader.
+
+        `wait_leader` and the POST are not atomic: the resolved node can
+        step down in between (its /healthz hint may even still name
+        itself), which is retryable operator business — re-resolve and
+        re-post, like a human operator would. `avoid` drains leadership
+        off that node first (decommission: never ask a node to remove
+        itself)."""
+        last: Optional[Exception] = None
+        for attempt in range(attempts):
+            leader = self._leader()
+            try:
+                if leader == avoid:
+                    self.cluster.admin_post(leader, "/admin/transfer", {})
+                    continue
+                return self.cluster.admin_post(leader, path, body)
+            except RuntimeError as e:
+                last = e
+                log.info("%s attempt %d on node %d failed: %s",
+                         path, attempt, leader, e)
+                time.sleep(0.5)
+        raise RuntimeError(
+            f"admin POST {path} kept failing across leaders: {last}"
+        ) from last
+
+    # -------------------------------------------------------------- events
+
+    def _chaos_campaign(self, event: SimEvent) -> str:
+        """Network chaos on every node's egress, with the leader's
+        campaign ending in a tutoring blackout (degraded answers).
+
+        The leader gets ONE campaign with both phases: CampaignRunner
+        replaces (cancels) any running campaign on the same node, so
+        posting the blackout separately would cancel the leader's chaos
+        phase milliseconds in."""
+        p = event.params
+        leader = self._leader()
+        t0 = None  # the leader's campaign clock starts at ITS post
+        for nid in self.cluster.node_ids():
+            phases = [{
+                # "*" shapes BOTH the Raft egress and the tutoring
+                # forward (FaultInjector.spec_for wildcard fallback).
+                "target": "*",
+                "duration_s": p["hold_s"], "drop": p["drop"],
+                "delay_s": p["delay_s"],
+                "delay_jitter_s": p["delay_jitter_s"],
+                "duplicate": p["duplicate"],
+            }]
+            name = "sim-network-chaos"
+            if nid == leader:
+                phases.append({"target": "tutoring",
+                               "duration_s": p["outage_hold_s"],
+                               "drop": 1.0})
+                name = "sim-chaos-then-blackout"
+            self.cluster.admin_post(nid, "/admin/faults",
+                                    {"campaign": {"name": name,
+                                                  "phases": phases}})
+            if nid == leader:
+                # Anchor the probe window on the leader's POST, not on
+                # some earlier instant: leader resolution and the other
+                # nodes' POSTs can eat most of a second on a loaded
+                # machine, and the blackout phase we probe runs on the
+                # leader's clock.
+                t0 = time.monotonic()
+        # The campaign is introspectable while live: GET /admin/faults
+        # (the plane used to be write-only).
+        some = self.cluster.node_ids()[0]
+        state = self.cluster.admin_get(some, "/admin/faults")
+        if not state["campaign"]["active"]:
+            raise RuntimeError(f"campaign not visible via GET: {state}")
+        # Wait out the chaos phase, then probe while the leader's
+        # blackout phase runs, guaranteeing the degraded path fires.
+        end = t0 + p["hold_s"] + p["outage_hold_s"]
+        time.sleep(max(0.0, t0 + p["hold_s"] + 0.1 - time.monotonic()))
+        degraded = 0
+        if self.asker is not None:
+            while time.monotonic() < end - 0.2 and degraded < 3:
+                if not self.asker():
+                    time.sleep(0.1)
+                    continue
+                degraded += 1
+        time.sleep(max(0.0, end - time.monotonic()))
+        return (f"chaos {p['hold_s']}s on all nodes; tutoring blackout "
+                f"{p['outage_hold_s']}s on leader {leader} "
+                f"({degraded} degraded probes)")
+
+    def _rolling_restart(self, event: SimEvent) -> str:
+        """Planned maintenance: TimeoutNow handoff off the leader, then
+        restart the ex-leader and wait for it to serve again. A transfer
+        can abort under load (the chosen target lags or a send drops);
+        that is retryable operator business, not a scenario failure."""
+        resp = None
+        for attempt in range(4):
+            leader = self._leader()
+            try:
+                resp = self.cluster.admin_post(leader, "/admin/transfer",
+                                               {})
+                break
+            except RuntimeError as e:
+                log.info("transfer attempt %d failed: %s", attempt, e)
+                time.sleep(0.5)
+        if resp is None:
+            raise RuntimeError("leadership transfer kept aborting")
+        target = resp["target"]
+        new_leader = self.cluster.wait_leader(timeout=15.0, exclude=leader)
+        self.cluster.restart_node(leader)
+        self.cluster.wait_healthy(leader, timeout=20.0)
+        return (f"transferred {leader} -> {target} (observed leader "
+                f"{new_leader}); restarted {leader}")
+
+    def _quarantine(self, event: SimEvent) -> str:
+        """Storage-recovery quarantine via the disk-fault admin plane:
+        flip bits on a follower's disk writes, restart it — it must boot
+        `storage_recovering`, rejoin via leader replication /
+        InstallSnapshot, and heal.
+
+        The restart follows the burst IMMEDIATELY: the victim's own
+        snapshot compaction rewrites a clean snapshot and truncates the
+        corrupt WAL prefix, so any post-clear dawdling can erase the
+        evidence and boot the node clean. That compaction race is real
+        (it depends on where the snapshot_every boundary lands), so a
+        clean boot retries the whole burst rather than failing the run.
+        """
+        p = event.params
+        attempts = 0
+        while True:
+            attempts += 1
+            leader = self._leader()
+            victim = next(n for n in self.cluster.node_ids()
+                          if n != leader)
+            self.cluster.admin_post(victim, "/admin/faults",
+                                    {"target": "disk", "bit_flip": 1.0})
+            # Acked writes DURING the burst: their WAL records on the
+            # victim are corrupt on disk while a healthy quorum holds
+            # them — the zero-loss SLO covers exactly these.
+            for _ in range(5):
+                if self.writer is not None:
+                    self.writer()
+                time.sleep(p["burst_s"] / 5)
+            self.cluster.admin_post(victim, "/admin/faults",
+                                    {"clear": "disk"})
+            self.cluster.restart_node(victim)
+            health = self.cluster.wait_healthy(victim, timeout=20.0)
+            if health.get("storage_recovering"):
+                break
+            if attempts >= 3:
+                raise RuntimeError(
+                    f"node {victim} restarted clean {attempts} times — "
+                    f"the disk-fault bursts never corrupted its WAL "
+                    f"(healthz: {health})"
+                )
+            time.sleep(p["settle_s"])
+        self.cluster.wait_until(
+            victim, lambda h: not h.get("storage_recovering"),
+            timeout=25.0, what="storage recovery to heal",
+        )
+        return (f"quarantined follower {victim} (attempt {attempts}); "
+                "healed via rejoin")
+
+    def _membership_add(self, event: SimEvent) -> str:
+        nid, address = self.cluster.spawn_extra_node()
+        resp = self._post_leader(
+            "/admin/membership",
+            {"op": "add", "id": nid, "address": address},
+        )
+        leader = self._leader()
+        self.cluster.wait_until(
+            leader, lambda h: str(nid) in h.get("members", {}),
+            timeout=15.0, what=f"member {nid} visible on leader",
+        )
+        return f"added node {nid} at {address} (index {resp['index']})"
+
+    def _membership_remove(self, event: SimEvent) -> str:
+        nid = self.cluster.extra_node_id()
+        if nid is None:
+            raise RuntimeError("no membership-added node to remove")
+        self._post_leader("/admin/membership",
+                          {"op": "remove", "id": nid}, avoid=nid)
+        leader = self._leader()
+        self.cluster.wait_until(
+            leader, lambda h: str(nid) not in h.get("members", {}),
+            timeout=15.0, what=f"member {nid} gone from leader view",
+        )
+        self.cluster.stop_node(nid)
+        return f"removed node {nid} and stopped it"

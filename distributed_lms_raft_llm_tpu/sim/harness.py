@@ -1,0 +1,526 @@
+"""`SemesterSim`: workload + operations schedule + auditors, end to end.
+
+One `run()` = one semester compressed into `duration_s` wall seconds:
+
+1. boot the in-process cluster (`SimCluster`);
+2. setup — register/login every actor, seed one material per course and
+   one assignment per student (ask_llm requires one);
+3. drive the seeded workload trace from `workers` client threads while
+   the operations scheduler injects the event plan (chaos campaigns,
+   TimeoutNow rolling restart, disk-fault quarantine, membership change)
+   through the real admin plane;
+4. settle — clear all faults, re-close every breaker by draining
+   leadership to any node whose breaker is still open (the operator's
+   decommission dance, automated), wait out storage recovery;
+5. audit — a fresh client re-reads the world and the ledger proves zero
+   acked-write loss; SLOs are evaluated from every node's `/metrics` and
+   `/healthz`;
+6. emit one BENCH-schema record (`scripts/semester_sim.py` prints it).
+
+The trace and the event plan are pure functions of the seed; the record
+carries their digests so a failure is replayable bit-for-bit at the
+decision level.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from ..client import LMSClient
+from ..client.client import NoLeader
+from ..config import SimConfig
+from ..utils import metrics_registry as metric
+from ..utils import pdf
+from ..utils.metrics import Metrics
+from ..utils.resilience import DeadlineExpired
+from . import events as ev
+from . import workload as wl
+from .cluster import SimCluster
+from .ledger import (
+    ASSIGNMENT,
+    GRADE,
+    MATERIAL,
+    QUERY,
+    USER,
+    WriteLedger,
+    content_hash,
+)
+from .slo import evaluate_slos
+
+log = logging.getLogger(__name__)
+
+class SimOpFailed(Exception):
+    """A simulated op the cluster refused at the application level."""
+
+
+_CLIENT_ERRORS = (grpc.RpcError, NoLeader, DeadlineExpired, TimeoutError,
+                  SimOpFailed)
+
+
+def _password(actor: str) -> str:
+    return f"pw-{actor}"
+
+
+def _is_degraded(resp) -> bool:
+    # Match the degraded-answer sentinel exactly: a gate rejection also
+    # mentions the instructor but queues NOTHING — counting it would
+    # record a ledger write the cluster never committed, and the audit
+    # would report a spurious acked-write loss.
+    return bool(resp.success) and "forwarded to an instructor" in resp.response
+
+
+class SemesterSim:
+    def __init__(self, cfg: SimConfig, workdir: str):
+        self.cfg = cfg
+        self.workdir = workdir
+        self.metrics = Metrics()
+        self.ledger = WriteLedger(metrics=self.metrics)
+        self.cluster = SimCluster(workdir, cfg)
+        self.gen = wl.WorkloadGenerator(cfg)
+        self._clients: Dict[str, LMSClient] = {}
+        self._ops_bot: Optional[LMSClient] = None
+        self._bot_lock = threading.Lock()
+        self._bot_seq = 0
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> Dict:
+        t_start = time.monotonic()
+        ops = self.gen.ops()
+        plan = ev.plan_events(self.cfg)
+        try:
+            # Inside the try: a partial boot (no leader within the
+            # timeout, a stolen port) must still tear the cluster down,
+            # or its loop thread and gRPC servers outlive the run.
+            self.cluster.start()
+            self._setup()
+            scheduler = ev.OperationsScheduler(
+                self.cluster, plan, metrics=self.metrics,
+                writer=self._bot_write, asker=self._bot_ask,
+            )
+            t0 = time.monotonic()
+            threads = self._start_workers(ops, t0)
+            scheduler.start(t0)
+            margin = 30.0 + self.cfg.llm_budget_s
+            for t in threads:
+                t.join(self.cfg.duration_s + margin)
+                if t.is_alive():
+                    raise TimeoutError(f"sim worker {t.name} wedged")
+            scheduler.join(self.cfg.duration_s + margin)
+            self._settle()
+            self._audit()
+            node_metrics, node_health = self.cluster.scrape_all()
+            report = evaluate_slos(
+                self.cfg, node_metrics, node_health,
+                self.metrics.snapshot(), self.ledger.report(),
+                event_failures=scheduler.failures(),
+                metrics=self.metrics,
+            )
+            return self._record(ops, plan, scheduler, report, node_metrics,
+                                time.monotonic() - t_start)
+        finally:
+            for c in self._clients.values():
+                c.close()
+            if self._ops_bot is not None:
+                self._ops_bot.close()
+            self.cluster.stop()
+
+    # ---------------------------------------------------------------- setup
+
+    def _new_client(self, actor: str,
+                    request_timeout_s: float = 15.0) -> LMSClient:
+        return LMSClient(
+            self.cluster.client_servers(),
+            discovery_rounds=8, discovery_backoff_s=0.2,
+            rpc_retries=6, rpc_timeout=5.0,
+            request_timeout_s=request_timeout_s,
+            llm_timeout_s=self.cfg.llm_budget_s,
+            backoff_base_s=0.02, backoff_max_s=0.3,
+            # Stable hash, NOT builtin hash(): PYTHONHASHSEED randomizes
+            # the latter per process, which would give every replay a
+            # different backoff-jitter stream and break the
+            # replay-from-seed contract.
+            seed=int(hashlib.sha1(
+                f"{self.cfg.seed}:{actor}".encode()
+            ).hexdigest(), 16) & 0xFFFF,
+        )
+
+    def _setup(self) -> None:
+        """Accounts + seed content, before the clock starts. Setup runs
+        fault-free, so failures here are raised, not tolerated."""
+        actors: List[Tuple[str, str]] = (
+            [(s, "student") for s in self.gen.students]
+            + [(i, "instructor") for i in self.gen.instructors]
+        )
+
+        errors: List[str] = []
+
+        def boot_actor(actor: str, role: str) -> None:
+            # Setup runs fault-free but NOT contention-free: at soak
+            # scale, dozens of concurrent account boots can push an
+            # attempt past its budget — retry the whole actor rather
+            # than fail the run before the scenario even starts.
+            last: Optional[Exception] = None
+            for _ in range(3):
+                try:
+                    c = self._clients.get(actor) or self._new_client(
+                        actor, request_timeout_s=30.0
+                    )
+                    self._clients[actor] = c
+                    c.register(actor, _password(actor), role)
+                    if not c.login(actor, _password(actor)):
+                        raise RuntimeError(
+                            f"setup: login failed for {actor}"
+                        )
+                    # Login success proves the account committed
+                    # (register alone can report 'exists' on a
+                    # retried-but-committed proposal).
+                    self.ledger.record(USER, (actor,), role)
+                    if role == "student":
+                        filename = f"{actor}_hw.pdf"
+                        data = pdf.make_pdf(
+                            f"{wl.ASSIGNMENT_TEXT} (initial submission "
+                            f"by {actor})"
+                        )
+                        if not c.upload_assignment(filename, data):
+                            raise RuntimeError(
+                                f"setup: upload failed for {actor}"
+                            )
+                        self.ledger.record(ASSIGNMENT, (actor, filename),
+                                           content_hash(data))
+                    return
+                except Exception as e:
+                    last = e
+                    time.sleep(0.5)
+            errors.append(f"{actor}: {last}")
+
+        def reap(t: threading.Thread) -> None:
+            t.join(60.0)
+            if t.is_alive():
+                # An abandoned boot thread would race the workload phase
+                # on its (shared, single-threaded-by-design) client —
+                # fail setup loudly instead.
+                errors.append(f"{t.name}: still running after 60s join")
+
+        threads = [threading.Thread(target=boot_actor, args=a,
+                                    name=f"setup-{a[0]}", daemon=True)
+                   for a in actors]
+        alive: List[threading.Thread] = []
+        for t in threads:
+            t.start()
+            alive.append(t)
+            if len(alive) >= self.cfg.workers:
+                reap(alive.pop(0))
+        for t in alive:
+            reap(t)
+        if errors:
+            raise RuntimeError(f"setup failed: {errors}")
+        # One seed material per course so downloads never start empty.
+        instructor = self.gen.instructors[0]
+        for course in self.gen.courses:
+            filename = f"{course}_syllabus.pdf"
+            data = pdf.make_pdf(f"{course} syllabus: {wl.ASSIGNMENT_TEXT}")
+            if not self._clients[instructor].upload_course_material(
+                filename, data
+            ):
+                raise RuntimeError(f"setup: material failed for {course}")
+            self.ledger.record(MATERIAL, (filename,), content_hash(data))
+        # The scheduler's ops bot: guaranteed-traffic writer + degraded
+        #-path prober (a student, so it can ask_llm).
+        bot = self._new_client("ops_bot")
+        bot.register("ops_bot", _password("ops_bot"), "student")
+        if not bot.login("ops_bot", _password("ops_bot")):
+            raise RuntimeError("setup: ops bot login failed")
+        self.ledger.record(USER, ("ops_bot",), "student")
+        data = pdf.make_pdf("ops bot assignment")
+        if not bot.upload_assignment("ops_bot_hw.pdf", data):
+            raise RuntimeError("setup: ops bot upload failed")
+        self.ledger.record(ASSIGNMENT, ("ops_bot", "ops_bot_hw.pdf"),
+                           content_hash(data))
+        self._ops_bot = bot
+
+    # ----------------------------------------------------------- scheduler IO
+
+    def _bot_write(self) -> bool:
+        """One guaranteed acked write (the quarantine event's record
+        source); ledger-tracked like any student write."""
+        with self._bot_lock:
+            self._bot_seq += 1
+            seq = self._bot_seq
+        query = f"ops bot write #{seq:04d}"
+        try:
+            if self._ops_bot.ask_instructor(query):
+                self.ledger.record(QUERY, ("ops_bot",), query)
+                return True
+        except _CLIENT_ERRORS as e:
+            log.info("ops bot write failed: %s", e)
+        return False
+
+    def _bot_ask(self) -> bool:
+        """One ask_llm probe; True if it was answered degraded."""
+        try:
+            resp = self._ops_bot.ask_llm("ops bot probe: what is Raft?",
+                                         budget_s=4.0)
+        except _CLIENT_ERRORS as e:
+            log.info("ops bot ask failed: %s", e)
+            return False
+        if _is_degraded(resp):
+            self.metrics.inc(metric.SIM_DEGRADED_ANSWERS)
+            self.ledger.record(QUERY, ("ops_bot",),
+                               "ops bot probe: what is Raft?")
+            return True
+        return False
+
+    # -------------------------------------------------------------- workload
+
+    def _start_workers(self, ops: List[wl.SimOp],
+                       t0: float) -> List[threading.Thread]:
+        # Partition by actor so each client (one token, one channel set)
+        # stays single-threaded; ops per actor run in trace order.
+        buckets: List[List[wl.SimOp]] = [[] for _ in range(self.cfg.workers)]
+        actor_ids = {a: i for i, a in enumerate(
+            self.gen.students + self.gen.instructors
+        )}
+        for op in ops:
+            buckets[actor_ids[op.actor] % self.cfg.workers].append(op)
+        threads = []
+        for w, bucket in enumerate(buckets):
+            t = threading.Thread(
+                target=self._worker, args=(bucket, t0),
+                name=f"sim-worker-{w}", daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        return threads
+
+    def _worker(self, bucket: List[wl.SimOp], t0: float) -> None:
+        # Closed-loop overload shedding: a worker that falls further
+        # behind the trace than its own op budget sheds the late op
+        # instead of building an unbounded backlog (which would wedge the
+        # run long past its duration when the engine is the bottleneck).
+        late_drop_s = self.cfg.llm_budget_s
+        for op in bucket:
+            delay = t0 + op.at_s - time.monotonic()
+            if delay < -late_drop_s:
+                self.metrics.inc(metric.SIM_OPS_DROPPED)
+                continue
+            if delay > 0:
+                time.sleep(delay)
+            started = time.monotonic()
+            try:
+                self._execute(op)
+                self.metrics.inc(metric.SIM_OPS_OK)
+            except _CLIENT_ERRORS as e:
+                # Terminal client failure (budget + retries exhausted):
+                # legal under faults — the op was never acked, so the
+                # ledger expects nothing from it.
+                log.info("sim op %s by %s failed: %s", op.kind, op.actor, e)
+                self.metrics.inc(metric.SIM_OPS_FAILED)
+            except Exception:
+                # A harness bug must not silently kill the worker thread
+                # (and every later op in its bucket) — count and carry on.
+                log.exception("sim op %s by %s raised unexpectedly",
+                              op.kind, op.actor)
+                self.metrics.inc(metric.SIM_OPS_FAILED)
+            finally:
+                self.metrics.hist(metric.SIM_OP_LATENCY).observe(
+                    time.monotonic() - started
+                )
+
+    def _execute(self, op: wl.SimOp) -> None:
+        c = self._clients[op.actor]
+        kind, payload = op.kind, op.payload
+        if kind == wl.UPLOAD_MATERIAL:
+            data = pdf.make_pdf(payload["text"])
+            if c.upload_course_material(payload["filename"], data):
+                self.ledger.record(MATERIAL, (payload["filename"],),
+                                   content_hash(data))
+        elif kind == wl.SUBMIT_ASSIGNMENT:
+            data = pdf.make_pdf(payload["text"])
+            if c.upload_assignment(payload["filename"], data):
+                self.ledger.record(ASSIGNMENT, (op.actor,
+                                                payload["filename"]),
+                                   content_hash(data))
+        elif kind == wl.GRADE:
+            resp = c.grade(payload["student"], payload["grade"])
+            if resp.success:
+                self.ledger.record(GRADE, (payload["student"],),
+                                   payload["grade"])
+        elif kind == wl.ASK_INSTRUCTOR:
+            if c.ask_instructor(payload["query"]):
+                self.ledger.record(QUERY, (op.actor,), payload["query"])
+        elif kind in (wl.ASK_LLM_ON_TOPIC, wl.ASK_LLM_OFF_TOPIC):
+            t1 = time.monotonic()
+            try:
+                resp = c.ask_llm(payload["query"],
+                                 budget_s=self.cfg.llm_budget_s)
+            finally:
+                self.metrics.hist(metric.SIM_ASK_LATENCY).observe(
+                    time.monotonic() - t1
+                )
+            if _is_degraded(resp):
+                # The degraded path IS a write: the query went onto the
+                # replicated instructor queue — hold the cluster to it.
+                self.metrics.inc(metric.SIM_DEGRADED_ANSWERS)
+                self.ledger.record(QUERY, (op.actor,), payload["query"])
+            elif not resp.success:
+                raise SimOpFailed(f"ask_llm refused: {resp.response[:80]}")
+        elif kind == wl.DOWNLOAD_MATERIAL:
+            t1 = time.monotonic()
+            entries = c.course_materials()
+            self.ledger.check_materials_read(
+                t1, {e.filename: bytes(e.file) for e in entries}, op.actor
+            )
+        elif kind == wl.CHECK_GRADE:
+            t1 = time.monotonic()
+            shown = c.my_grade()
+            self.ledger.check_grade_read(t1, shown, op.actor)
+        elif kind == wl.READ_RESPONSES:
+            t1 = time.monotonic()
+            texts = [e.data for e in c.instructor_responses()]
+            self.ledger.check_responses_read(t1, texts, op.actor)
+        else:  # pragma: no cover - generator and executor share the enum
+            raise ValueError(f"unknown op kind {kind!r}")
+
+    # ---------------------------------------------------------------- settle
+
+    def _settle(self) -> None:
+        """Back to blue skies: clear every fault, then re-close every
+        breaker. A breaker only sees traffic while its node leads, so a
+        node that led through the tutoring blackout and then lost
+        leadership would hold an open breaker forever; the settle drains
+        leadership to each such node and probes until it closes — the
+        automated version of an operator's post-incident checklist."""
+        for nid in self.cluster.node_ids():
+            self.cluster.admin_post(nid, "/admin/faults", {"reset": True})
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            leader = self.cluster.wait_leader(timeout=10.0)
+            if leader is None:
+                continue
+            open_nodes = [
+                nid for nid in self.cluster.node_ids()
+                if self.cluster.healthz(nid)
+                .get("tutoring_breaker", {}).get("state") != "closed"
+            ]
+            if not open_nodes:
+                return
+            target = open_nodes[0]
+            if target != leader:
+                try:
+                    self.cluster.admin_post(leader, "/admin/transfer",
+                                            {"target": target})
+                except RuntimeError as e:
+                    log.info("settle transfer to %d failed: %s", target, e)
+                    continue
+            # recovery_s is 0.5 in the sim cluster: give the breaker its
+            # half-open window, then probe until a success closes it.
+            time.sleep(0.6)
+            try:
+                # "ops bot ..." overlaps the bot's assignment text, so the
+                # probe passes the relevance gate and reaches tutoring —
+                # a gated-out probe could never close the breaker.
+                resp = self._ops_bot.ask_llm("ops bot settle probe?",
+                                             budget_s=4.0)
+                if _is_degraded(resp):
+                    self.metrics.inc(metric.SIM_DEGRADED_ANSWERS)
+                    self.ledger.record(QUERY, ("ops_bot",),
+                                       "ops bot settle probe?")
+            except _CLIENT_ERRORS as e:
+                log.info("settle probe failed: %s", e)
+        raise TimeoutError("settle: breakers never re-closed")
+
+    # ----------------------------------------------------------------- audit
+
+    def _audit(self) -> None:
+        """Fresh reads of the final state feed the ledger's loss audit."""
+        auditor = self._new_client("auditor")
+        try:
+            users: Dict[str, str] = {}
+            for actor, role in (
+                [(s, "student") for s in self.gen.students]
+                + [(i, "instructor") for i in self.gen.instructors]
+                + [("ops_bot", "student")]
+            ):
+                try:
+                    if auditor.login(actor, _password(actor)):
+                        users[actor] = role
+                except _CLIENT_ERRORS:
+                    pass
+            # Materials: any student's view (reads are linearizable).
+            student = self.gen.students[0]
+            if not auditor.login(student, _password(student)):
+                raise RuntimeError("audit: student login failed")
+            materials = {e.filename: bytes(e.file)
+                         for e in auditor.course_materials()}
+            grades: Dict[str, str] = {}
+            for s in self.gen.students:
+                if auditor.login(s, _password(s)):
+                    grades[s] = auditor.my_grade()
+            instructor = self.gen.instructors[0]
+            if not auditor.login(instructor, _password(instructor)):
+                raise RuntimeError("audit: instructor login failed")
+            assignments: Dict[str, List[str]] = {}
+            for e in auditor.student_assignments():
+                assignments.setdefault(e.id, []).append(e.filename)
+            queries = [(e.id, e.data)
+                       for e in auditor.unanswered_queries()]
+            self.ledger.audit(users=users, materials=materials,
+                              assignments=assignments, grades=grades,
+                              queries=queries)
+        finally:
+            auditor.close()
+
+    # ---------------------------------------------------------------- record
+
+    def _record(self, ops, plan, scheduler, report, node_metrics,
+                wall_s: float) -> Dict:
+        snap = self.metrics.snapshot()
+        counters = snap.get("counters", {})
+        ask = snap.get("latency", {}).get("sim_ask_latency", {})
+        ledger_report = self.ledger.report()
+
+        def node_sum(name: str) -> int:
+            # Undercounts across a rolling restart (the restarted node's
+            # counters reset) — good enough for ">= 1 really happened".
+            return sum(int(s.get("counters", {}).get(name, 0))
+                       for s in node_metrics.values())
+        return {
+            # BENCH schema: one headline metric + the full story around it.
+            "metric": "semester_sim_ask_p95_s",
+            "value": round(float(ask.get("p95_s", 0.0)), 3),
+            "unit": "s",
+            "seed": self.cfg.seed,
+            "students": self.cfg.students,
+            "duration_s": self.cfg.duration_s,
+            "tutoring_engine": self.cfg.tutoring_engine,
+            "trace_digest": wl.trace_digest(ops),
+            "event_digest": _event_digest(plan),
+            "ops_planned": len(ops),
+            "ops_ok": counters.get("sim_ops_ok", 0),
+            "ops_failed": counters.get("sim_ops_failed", 0),
+            "ops_dropped": counters.get("sim_ops_dropped", 0),
+            "asks": ask.get("count", 0),
+            "degraded_answers": counters.get("sim_degraded_answers", 0),
+            "gate_pass": node_sum("gate_pass"),
+            "gate_reject": node_sum("gate_reject"),
+            "acked_writes": ledger_report["acked_writes"],
+            "events": scheduler.outcomes,
+            "events_executed": scheduler.executed_kinds(),
+            "slos": report.to_dict(),
+            "wall_s": round(wall_s, 1),
+        }
+
+
+def _event_digest(plan: List[ev.SimEvent]) -> str:
+    h = hashlib.sha256()
+    for e in plan:
+        h.update(e.key().encode())
+        h.update(b"\n")
+    return h.hexdigest()[:16]

@@ -1,0 +1,154 @@
+"""End-of-run SLO assertions from `/metrics` and `/healthz`.
+
+The semester sim's verdict: after the workload finishes, faults clear,
+and the cluster settles, the SLOs are evaluated against what the CLUSTER
+exports (every node's `/metrics` and `/healthz` snapshots, scraped over
+HTTP) plus the harness's own client-side series — not against internal
+test handles — so the same checks an operator's alerting would run are
+what gate the run.
+
+Checks:
+- zero acked-write loss + read-your-writes (the ledger's history audit);
+- answer p95 under the bound, both client-observed (`sim_ask_latency`)
+  and server-side (every node's `llm_ttft` p95 from `/metrics`);
+- degraded-answer rate bounded (Σ tutoring_degraded / Σ llm_requests);
+- every tutoring breaker re-closed (`/healthz`);
+- no node stuck `storage_recovering` (`/healthz` + the gauge);
+- `raft_tick_stalls` bounded across the cluster;
+- every planned operations event completed (`event_failures` from the
+  scheduler): the acceptance criteria — >=1 transfer, >=1 quarantine,
+  >=1 membership change — are part of the verdict, not just the CLI's
+  exit code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+from ..config import SimConfig
+from ..utils import metrics_registry as metric
+
+
+@dataclasses.dataclass(frozen=True)
+class SloCheck:
+    name: str
+    ok: bool
+    observed: str
+    bound: str
+
+
+@dataclasses.dataclass
+class SloReport:
+    checks: List[SloCheck]
+
+    @property
+    def ok(self) -> bool:
+        return all(c.ok for c in self.checks)
+
+    def failures(self) -> List[SloCheck]:
+        return [c for c in self.checks if not c.ok]
+
+    def to_dict(self) -> Dict:
+        return {
+            "ok": self.ok,
+            "checks": {c.name: {"ok": c.ok, "observed": c.observed,
+                                "bound": c.bound}
+                       for c in self.checks},
+        }
+
+
+def _counter(snap: Dict, name: str) -> int:
+    return int(snap.get("counters", {}).get(name, 0))
+
+
+def _gauge(snap: Dict, name: str, default: float = 0.0) -> float:
+    return float(snap.get("gauges", {}).get(name, default))
+
+
+def evaluate_slos(
+    cfg: SimConfig,
+    node_metrics: Dict[int, Dict],
+    node_health: Dict[int, Dict],
+    sim_metrics: Dict,
+    ledger_report: Dict,
+    *,
+    event_failures: Sequence[Dict] = (),
+    metrics=None,
+) -> SloReport:
+    """`node_metrics`/`node_health`: node id -> scraped JSON snapshots of
+    every node alive at the end of the run; `sim_metrics`: the harness's
+    own Metrics snapshot; `ledger_report`: `WriteLedger.report()`;
+    `event_failures`: the scheduler's `ok=False` outcomes."""
+    checks: List[SloCheck] = []
+
+    def check(name: str, ok: bool, observed: str, bound: str) -> None:
+        checks.append(SloCheck(name=name, ok=ok, observed=observed,
+                               bound=bound))
+        if not ok and metrics is not None:
+            metrics.inc(metric.SIM_SLO_VIOLATIONS)
+
+    losses = ledger_report["losses"]
+    check("zero_acked_write_loss", not losses,
+          f"{len(losses)} lost of {ledger_report['acked_writes']} acked"
+          + (f": {losses[:3]}" if losses else ""), "0 lost")
+    ryw = ledger_report["ryw_violations"]
+    check("read_your_writes", not ryw,
+          f"{len(ryw)} violations" + (f": {ryw[:3]}" if ryw else ""), "0")
+
+    ask = sim_metrics.get("latency", {}).get("sim_ask_latency", {})
+    client_p95 = ask.get("p95_s")
+    check(
+        "answer_p95_client", client_p95 is None
+        or client_p95 <= cfg.slo_answer_p95_s,
+        f"{client_p95 if client_p95 is not None else 'n/a'} s "
+        f"({ask.get('count', 0)} asks)",
+        f"<= {cfg.slo_answer_p95_s} s",
+    )
+    worst = 0.0
+    for snap in node_metrics.values():
+        hist = snap.get("latency", {}).get("llm_ttft", {})
+        worst = max(worst, float(hist.get("p95_s", 0.0)))
+    check("answer_p95_nodes", worst <= cfg.slo_answer_p95_s,
+          f"worst node llm_ttft p95 {worst:.3f} s",
+          f"<= {cfg.slo_answer_p95_s} s")
+
+    degraded = sum(_counter(s, "tutoring_degraded")
+                   for s in node_metrics.values())
+    requests = sum(_counter(s, "llm_requests") for s in node_metrics.values())
+    rate = degraded / requests if requests else 0.0
+    check("degraded_rate", rate <= cfg.slo_degraded_rate_max,
+          f"{degraded}/{requests} = {rate:.3f}",
+          f"<= {cfg.slo_degraded_rate_max}")
+
+    open_breakers = {
+        nid: h.get("tutoring_breaker", {}).get("state")
+        for nid, h in node_health.items()
+        if h.get("tutoring_breaker", {}).get("state") != "closed"
+    }
+    check("breakers_closed", not open_breakers,
+          f"open: {open_breakers}" if open_breakers else "all closed",
+          "closed on every node")
+
+    stuck = sorted(
+        set(
+            [nid for nid, h in node_health.items()
+             if h.get("storage_recovering")]
+            + [nid for nid, s in node_metrics.items()
+               if _gauge(s, "storage_recovering") > 0]
+        )
+    )
+    check("no_stuck_storage_recovery", not stuck,
+          f"recovering: {stuck}" if stuck else "none recovering", "none")
+
+    stalls = sum(_counter(s, "raft_tick_stalls")
+                 for s in node_metrics.values())
+    check("tick_stalls", stalls <= cfg.slo_tick_stalls_max,
+          f"{stalls} stalls summed", f"<= {cfg.slo_tick_stalls_max}")
+
+    failed = [f"{o['kind']}: {o['detail']}" for o in event_failures]
+    check("events_completed", not failed,
+          f"{len(failed)} failed" + (f": {failed[:3]}" if failed else ""),
+          "every planned event ok")
+
+    return SloReport(checks=checks)

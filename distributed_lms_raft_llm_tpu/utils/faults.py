@@ -26,11 +26,15 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 import random
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..raft.node import Transport
+from . import metrics_registry as metric
+
+log = logging.getLogger(__name__)
 
 
 class FaultInjected(ConnectionError):
@@ -145,6 +149,169 @@ class FaultInjector:
                     t: dataclasses.asdict(s) for t, s in self._specs.items()
                 },
             }
+
+
+@dataclasses.dataclass(frozen=True)
+class CampaignPhase:
+    """One timed step of a chaos campaign: install `spec` on `target` for
+    `duration_s`, then clear it. `target` routes like the one-shot admin
+    plane: `"disk"` goes to the disk injector, anything else (including
+    the `"*"` wildcard) to the network injector."""
+
+    target: str
+    duration_s: float
+    spec: Dict[str, float]
+
+    @staticmethod
+    def from_json(raw: dict) -> "CampaignPhase":
+        if "target" not in raw:
+            raise ValueError("campaign phase needs a 'target'")
+        duration = float(raw.get("duration_s", 0.0))
+        if duration <= 0.0:
+            raise ValueError("campaign phase needs duration_s > 0")
+        spec = {k: v for k, v in raw.items()
+                if k not in ("target", "duration_s")}
+        return CampaignPhase(target=str(raw["target"]), duration_s=duration,
+                             spec=spec)
+
+
+class CampaignRunner:
+    """Timed fault campaigns over one node's injectors.
+
+    A campaign is a named sequence of `CampaignPhase`s the admin plane
+    schedules in one POST instead of an operator hand-driving configure/
+    clear pairs: each phase installs its spec, holds it for its duration,
+    then clears that target before the next phase. `GET /admin/faults`
+    reports the live phase so the semester simulator (and operators) can
+    assert exactly what is injected mid-run.
+
+    Runs on the node's event loop (started from the admin handler); all
+    state is loop-confined. Cancellation — explicit or via a replacing
+    campaign — clears every target the campaign touched, so a cancelled
+    campaign can never strand a fault spec.
+    """
+
+    def __init__(self, faults: FaultInjector, disk_faults=None, metrics=None):
+        self.faults = faults
+        self.disk_faults = disk_faults
+        self.metrics = metrics
+        self._task: Optional[asyncio.Task] = None  # guarded-by: event-loop
+        self._name: Optional[str] = None           # guarded-by: event-loop
+        self._phases: List[CampaignPhase] = []     # guarded-by: event-loop
+        self._phase_index: int = -1                # guarded-by: event-loop
+        self._completed: int = 0                   # guarded-by: event-loop
+
+    @property
+    def active(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def start(self, name: str, phases: List[dict]) -> dict:
+        """Parse + validate every phase up front (a typo'd field must fail
+        the POST, not abort the campaign mid-run), then schedule."""
+        parsed = [CampaignPhase.from_json(p) for p in phases]
+        if not parsed:
+            raise ValueError("campaign needs at least one phase")
+        for p in parsed:  # validate spec fields without touching live specs
+            if p.target == "disk":
+                if self.disk_faults is None:
+                    raise ValueError("no disk injector on this node")
+                from .diskfaults import DiskFaultSpec
+
+                known = {f.name for f in dataclasses.fields(DiskFaultSpec)}
+            else:
+                known = {f.name for f in dataclasses.fields(FaultSpec)}
+            bad = set(p.spec) - known
+            if bad:
+                raise ValueError(
+                    f"unknown fault field(s) {sorted(bad)} for target "
+                    f"{p.target!r} (known: {sorted(known)})"
+                )
+        prior = self._task
+        self.cancel()
+        self._name, self._phases, self._phase_index = name, parsed, -1
+        self._task = asyncio.ensure_future(self._run(parsed, prior=prior))
+        self._task.add_done_callback(self._on_done)
+        return self.snapshot()
+
+    def cancel(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = None
+        self._phase_index = -1
+
+    async def stop(self) -> None:
+        """`cancel`, then wait for the teardown to land: by return, every
+        spec the campaign installed has been cleared. The admin plane's
+        cancel paths use this so the POST *response* snapshot never shows
+        the cancelled campaign's spec as still installed (cancel() alone
+        only schedules the task's finally-clear)."""
+        task = self._task
+        self.cancel()
+        if task is not None and not task.done():
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            except Exception:  # already logged by its done callback
+                pass
+
+    def snapshot(self) -> dict:
+        phase = None
+        if self.active and 0 <= self._phase_index < len(self._phases):
+            p = self._phases[self._phase_index]
+            phase = {"target": p.target, "duration_s": p.duration_s,
+                     **p.spec}
+        return {
+            "active": self.active,
+            "name": self._name,
+            "phase_index": self._phase_index if self.active else None,
+            "phases_total": len(self._phases),
+            "phases_completed_total": self._completed,
+            "phase": phase,
+        }
+
+    # ------------------------------------------------------------ internals
+
+    async def _run(self, phases: List[CampaignPhase],
+                   prior: Optional[asyncio.Task] = None) -> None:
+        if prior is not None and not prior.done():
+            # Serialize the handoff: the replaced campaign's finally-clear
+            # must land BEFORE this campaign installs a spec on the same
+            # target, or the old teardown would wipe the new phase.
+            try:
+                await prior
+            except asyncio.CancelledError:
+                if not prior.cancelled():
+                    raise  # our own cancellation, not the predecessor's
+            except Exception:  # already logged by its done callback
+                pass
+        for i, phase in enumerate(phases):
+            if self._task is not asyncio.current_task():
+                return  # superseded while waiting on the predecessor
+            self._phase_index = i
+            try:
+                if phase.target == "disk":
+                    self.disk_faults.configure(**phase.spec)
+                else:
+                    self.faults.configure(phase.target, **phase.spec)
+                if self.metrics is not None:
+                    self.metrics.inc(metric.FAULT_CAMPAIGN_PHASES)
+                self._completed += 1
+                await asyncio.sleep(phase.duration_s)
+            finally:
+                # Clear even on cancellation: a campaign must never strand
+                # its spec past its lifetime.
+                if phase.target == "disk":
+                    self.disk_faults.clear()
+                else:
+                    self.faults.clear(phase.target)
+
+    def _on_done(self, task: asyncio.Task) -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            log.warning("fault campaign %r failed: %s", self._name, exc)
 
 
 class FaultyTransport(Transport):

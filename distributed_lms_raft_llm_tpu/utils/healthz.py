@@ -11,6 +11,10 @@ can scrape without a gRPC client:
                      on the LMS leader: serving/lms_server.py) — JSON body
                      in, JSON out; the admin plane stays off the frozen
                      gRPC wire contract
+    GET /admin/*  -> optional READ-ONLY admin hook (`admin_get`), e.g.
+                     GET /admin/faults returns the active fault/campaign
+                     configuration so operators and the semester simulator
+                     can assert what is injected; mutations stay POST-only
 
 Serving is a ~60-line asyncio protocol rather than http.server-in-a-thread
 so it shares the node's event loop (single-threaded by construction, like
@@ -29,6 +33,9 @@ Provider = Callable[[], Dict]
 # (path, body) -> response dict; raise KeyError for unknown paths,
 # ValueError for bad requests.
 AdminHandler = Callable[[str, Dict], Awaitable[Dict]]
+# path -> response dict for GET /admin/* (read-only introspection; same
+# KeyError/ValueError error mapping as the POST handler).
+AdminGetHandler = Callable[[str], Awaitable[Dict]]
 
 
 class HealthServer:
@@ -38,12 +45,14 @@ class HealthServer:
         *,
         health: Optional[Provider] = None,
         admin: Optional[AdminHandler] = None,
+        admin_get: Optional[AdminGetHandler] = None,
         host: str = "127.0.0.1",
         port: int = 0,
     ):
         self.metrics = metrics
         self.health = health or (lambda: {"ok": True})
         self.admin = admin
+        self.admin_get = admin_get
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -84,6 +93,19 @@ class HealthServer:
                 body, status = json.dumps(self.health()), 200
             elif path == "/metrics":
                 body, status = json.dumps(self.metrics.snapshot()), 200
+            elif (
+                method == "GET"
+                and path.startswith("/admin/")
+                and self.admin_get is not None
+            ):
+                try:
+                    body, status = json.dumps(await self.admin_get(path)), 200
+                except KeyError:
+                    body, status = json.dumps({"error": "not found"}), 404
+                except ValueError as e:
+                    body, status = json.dumps({"error": str(e)}), 400
+                except Exception as e:  # surfaced, not swallowed
+                    body, status = json.dumps({"error": str(e)}), 500
             elif (
                 method == "POST"
                 and path.startswith("/admin/")

@@ -53,6 +53,9 @@ class LatencyHistogram:
                 "mean_s": self._total / self._count,
                 "p50_s": self._samples[n // 2],
                 "p90_s": self._samples[min(int(n * 0.9), n - 1)],
+                # p95 is the SLO percentile the semester simulator (sim/)
+                # asserts from /metrics, so it ships in every snapshot.
+                "p95_s": self._samples[min(int(n * 0.95), n - 1)],
                 "p99_s": self._samples[min(int(n * 0.99), n - 1)],
                 "max_s": self._samples[-1],
             }

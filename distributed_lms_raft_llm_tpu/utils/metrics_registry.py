@@ -247,6 +247,62 @@ STALE_TMP_FILES_REMOVED = counter(
     "swept at boot, leaked by a crash between mkstemp and rename",
 )
 
+# Chaos admin plane (utils/faults.py CampaignRunner, serving/lms_server.py).
+
+FAULT_CAMPAIGN_PHASES = counter(
+    "fault_campaign_phases",
+    "fault-campaign phases the admin plane applied (each phase installs "
+    "one injector spec for its duration, then clears it)",
+)
+
+# Semester simulator (sim/): client-side series the harness exports in its
+# BENCH record; the SLO checker reads them next to the cluster's /metrics.
+
+SIM_OPS_OK = counter(
+    "sim_ops_ok", "simulated student/instructor ops that succeeded"
+)
+SIM_OPS_FAILED = counter(
+    "sim_ops_failed",
+    "simulated ops that failed terminally (retries and budget exhausted)",
+)
+SIM_OPS_DROPPED = counter(
+    "sim_ops_dropped",
+    "simulated ops shed unexecuted because their worker fell further "
+    "behind the trace than the lag bound (closed-loop overload, not a "
+    "cluster failure)",
+)
+SIM_OP_LATENCY = histogram(
+    "sim_op_latency", "client-observed latency of every simulated op"
+)
+SIM_ASK_LATENCY = histogram(
+    "sim_ask_latency",
+    "client-observed ask_llm latency (its p95 is the semester-sim answer "
+    "SLO)",
+)
+SIM_DEGRADED_ANSWERS = counter(
+    "sim_degraded_answers",
+    "ask_llm calls answered by the degraded instructor-queue fallback, "
+    "as seen by the simulated clients",
+)
+SIM_EVENTS_INJECTED = counter(
+    "sim_events_injected",
+    "operations-schedule events the semester sim executed (transfers, "
+    "quarantines, membership changes, chaos campaigns)",
+)
+SIM_RYW_VIOLATIONS = counter(
+    "sim_ryw_violations",
+    "read-your-writes violations the in-run ledger auditor observed "
+    "(a write acked before the read started was not visible)",
+)
+SIM_ACKED_WRITE_LOSSES = counter(
+    "sim_acked_write_losses",
+    "acked writes the end-of-run ledger audit could not find in the "
+    "cluster (the zero-acked-write-loss SLO; must stay 0)",
+)
+SIM_SLO_VIOLATIONS = counter(
+    "sim_slo_violations", "semester-sim SLO checks that failed"
+)
+
 # Raft runner (utils/guards.py LoopWatchdog wired by lms/node.py).
 
 RAFT_TICK_LAG = histogram(

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Semester simulator CLI: one production scenario, one JSON verdict.
+
+Boots an in-process LMS cluster (3 Raft nodes + tutoring), drives a
+seeded semester of student traffic along a diurnal curve while the
+operations schedule injects a chaos campaign, a TimeoutNow rolling
+restart, a disk-fault storage-recovery quarantine, and a membership
+add/remove — then audits the acked-write ledger and asserts the SLOs
+from every node's /metrics and /healthz.
+
+Prints ONE BENCH-schema JSON line (metric: semester_sim_ask_p95_s) with
+the full story: per-event outcomes, SLO verdicts, ledger counts, and the
+trace/event digests that make a failed seed replayable:
+
+    python scripts/semester_sim.py                      # [sim] defaults
+    python scripts/semester_sim.py --seed 7 --duration 60 --students 48
+    python scripts/semester_sim.py --config configs/cluster.toml
+
+Exit status 0 only if every event executed and every SLO held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default=None,
+                    help="TOML deployment file; its [sim] section seeds "
+                         "the defaults, flags below override")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--students", type=int, default=None)
+    ap.add_argument("--duration", type=float, default=None,
+                    help="workload wall-clock seconds")
+    ap.add_argument("--base-rate", type=float, default=None,
+                    help="mean op arrival rate (ops/s)")
+    ap.add_argument("--engine", choices=["echo", "tiny"], default=None,
+                    help="tutoring engine: wire-complete echo stand-in "
+                         "or the real tiny JAX engine")
+    ap.add_argument("--no-events", action="store_true",
+                    help="pure-workload run (no operations schedule)")
+    ap.add_argument("--keep-workdir", action="store_true")
+    args = ap.parse_args()
+
+    import dataclasses
+
+    from distributed_lms_raft_llm_tpu.config import SimConfig, load_config
+    from distributed_lms_raft_llm_tpu.sim import SemesterSim
+
+    cfg = (load_config(args.config).sim if args.config else SimConfig())
+    overrides = {}
+    if args.seed is not None:
+        overrides["seed"] = args.seed
+    if args.students is not None:
+        overrides["students"] = args.students
+    if args.duration is not None:
+        overrides["duration_s"] = args.duration
+    if args.base_rate is not None:
+        overrides["base_rate"] = args.base_rate
+    if args.engine is not None:
+        overrides["tutoring_engine"] = args.engine
+    if args.no_events:
+        overrides["events"] = False
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+
+    workdir = tempfile.mkdtemp(prefix="semester_sim_")
+    try:
+        record = SemesterSim(cfg, workdir).run()
+        print(json.dumps(record))
+        ok = record["slos"]["ok"] and not [
+            e for e in record["events"] if not e["ok"]
+        ]
+        return 0 if ok else 1
+    finally:
+        if args.keep_workdir:
+            sys.stderr.write(f"workdir kept at {workdir}\n")
+        else:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

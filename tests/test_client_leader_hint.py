@@ -1,0 +1,218 @@
+"""Client leader-hint cache under membership churn (satellite of the
+semester-sim PR).
+
+The failure this pins: the client's cached leader hint points at a node
+that a membership change removed (and that then went away). Before the
+fix, (a) the hint was never evicted, (b) discovery re-trusted the first
+stale report naming the dead address, and (c) `RaftServicer.GetLeader`
+answered from a boot-time COPY of the address map, so a
+membership-ADDED leader's address was unreportable and the client could
+never follow the cluster off its boot list. Now: the failed address is
+evicted and probed last, stale first-round reports naming it are
+skipped, and the servicer shares the LMSNode's live map.
+"""
+
+import asyncio
+import threading
+
+import grpc
+
+from distributed_lms_raft_llm_tpu.client import LMSClient
+from distributed_lms_raft_llm_tpu.lms.node import LMSNode
+from distributed_lms_raft_llm_tpu.lms.service import (
+    FileTransferServicer,
+    LMSServicer,
+)
+from distributed_lms_raft_llm_tpu.proto import rpc
+from distributed_lms_raft_llm_tpu.raft import RaftConfig
+from distributed_lms_raft_llm_tpu.raft.grpc_transport import RaftServicer
+
+FAST = RaftConfig(
+    election_timeout_min=0.11, election_timeout_max=0.22,
+    heartbeat_interval=0.05,
+)
+
+
+def _boot_node(loop, tmp_path, nid, addresses):
+    """One LMS node + gRPC server on `loop`; returns its record."""
+
+    async def boot():
+        server = grpc.aio.server()
+        port = int(addresses[nid].rsplit(":", 1)[1])
+        bound = server.add_insecure_port(f"127.0.0.1:{port}")
+        assert bound == port
+        node = LMSNode(nid, addresses, str(tmp_path / f"node{nid}"),
+                       raft_config=FAST)
+        rpc.add_LMSServicer_to_server(
+            LMSServicer(node.node, node.state, node.blobs,
+                        peer_addresses=node.addresses, self_id=nid),
+            server,
+        )
+        rpc.add_RaftServiceServicer_to_server(
+            # LIVE map (the fix under test): GetLeader must be able to
+            # name a membership-added node.
+            RaftServicer(node.node, node.addresses,
+                         kv=node.state.data["kv"]),
+            server,
+        )
+        rpc.add_FileTransferServiceServicer_to_server(
+            FileTransferServicer(node.blobs), server
+        )
+        await server.start()
+        await node.start()
+        return {"node": node, "server": server}
+
+    return asyncio.run_coroutine_threadsafe(boot(), loop).result(30)
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_hint_evicted_and_added_leader_discovered(tmp_path):
+    """Rolling decommission: leadership moves to a membership-ADDED node,
+    the old (hinted) leader is removed and stopped — the client must
+    evict the dead hint, learn the new leader's off-boot-list address
+    from any live peer, and finish its op inside its budget."""
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    nodes = {}
+    client = None
+    try:
+        addresses = {i: f"127.0.0.1:{_free_port()}" for i in (1, 2, 3)}
+        for i in (1, 2, 3):
+            nodes[i] = _boot_node(loop, tmp_path, i, dict(addresses))
+
+        client = LMSClient(
+            [addresses[i] for i in (1, 2, 3)],
+            discovery_rounds=8, discovery_backoff_s=0.1,
+            rpc_retries=8, request_timeout_s=20.0,
+            backoff_base_s=0.02, backoff_max_s=0.2, seed=3,
+        )
+        # A retried-but-committed Register reports "exists" (the frozen
+        # proto carries no request id); login success proves the account
+        # committed either way.
+        client.register("ana", "pw", "student")
+        assert client.login("ana", "pw")
+        hinted = client._leader_addr
+        assert hinted in addresses.values()
+        leader_id = next(i for i, a in addresses.items() if a == hinted)
+
+        async def admin():
+            leader = nodes[leader_id]["node"]
+            # Add node 4 (booted first, operator-style), hand leadership
+            # to it, then remove + stop the old leader.
+            members = {**{i: addresses[i] for i in (1, 2, 3)},
+                       4: addresses[4]}
+            await leader.node.propose_config(members)
+            await leader.node.transfer_leadership(4)
+
+        addresses[4] = f"127.0.0.1:{_free_port()}"
+        nodes[4] = _boot_node(loop, tmp_path, 4, dict(addresses))
+        asyncio.run_coroutine_threadsafe(admin(), loop).result(30)
+
+        async def decommission():
+            new_leader = nodes[4]["node"]
+            deadline = asyncio.get_running_loop().time() + 10
+            while not new_leader.node.is_leader:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("transfer never settled on 4")
+                await asyncio.sleep(0.05)
+            members = {i: addresses[i] for i in (2, 3, 4)}
+            # A freshly-transferred leader reports the prior config
+            # change in flight until it commits in its own term.
+            from distributed_lms_raft_llm_tpu.raft.core import (
+                ConfigChangeInFlight,
+            )
+
+            for _ in range(50):
+                try:
+                    await new_leader.node.propose_config(members)
+                    break
+                except ConfigChangeInFlight:
+                    await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("remove config never accepted")
+            old = nodes.pop(leader_id)
+            await old["node"].stop()
+            await old["server"].stop(None)
+
+        asyncio.run_coroutine_threadsafe(decommission(), loop).result(30)
+
+        # The client still hints at the dead, removed ex-leader.
+        assert client._leader_addr == hinted
+        assert client.login("ana", "pw"), (
+            "op must succeed after the hinted node was removed"
+        )
+        assert client._leader_addr != hinted, "dead hint must be evicted"
+        assert client._leader_addr == addresses[4], (
+            f"client should have learned the added leader "
+            f"{addresses[4]}, hints {client._leader_addr}"
+        )
+        # The learned address becomes a discovery peer of its own.
+        assert addresses[4] in client._extra_servers
+    finally:
+        if client is not None:
+            client.close()
+
+        async def teardown():
+            for rec in nodes.values():
+                await rec["node"].stop()
+                await rec["server"].stop(None)
+
+        asyncio.run_coroutine_threadsafe(teardown(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)
+
+
+def test_unavailable_hint_falls_back_to_live_peer(tmp_path):
+    """Mid-churn UNAVAILABLE: the hinted leader stops; the client must
+    evict the hint and recover via the remaining quorum."""
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    nodes = {}
+    client = None
+    try:
+        addresses = {i: f"127.0.0.1:{_free_port()}" for i in (1, 2, 3)}
+        for i in (1, 2, 3):
+            nodes[i] = _boot_node(loop, tmp_path, i, dict(addresses))
+        client = LMSClient(
+            [addresses[i] for i in (1, 2, 3)],
+            discovery_rounds=8, discovery_backoff_s=0.1,
+            rpc_retries=8, request_timeout_s=20.0,
+            backoff_base_s=0.02, backoff_max_s=0.2, seed=5,
+        )
+        client.register("bo", "pw", "student")
+        assert client.login("bo", "pw")  # proves the register committed
+        hinted = client._leader_addr
+        leader_id = next(i for i, a in addresses.items() if a == hinted)
+
+        async def kill_leader():
+            rec = nodes.pop(leader_id)
+            await rec["node"].stop()
+            await rec["server"].stop(None)
+
+        asyncio.run_coroutine_threadsafe(kill_leader(), loop).result(30)
+        client.register("cy", "pw", "student")
+        assert client.login("cy", "pw")  # proves the register committed
+        assert client._leader_addr != hinted
+    finally:
+        if client is not None:
+            client.close()
+
+        async def teardown():
+            for rec in nodes.values():
+                await rec["node"].stop()
+                await rec["server"].stop(None)
+
+        asyncio.run_coroutine_threadsafe(teardown(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)

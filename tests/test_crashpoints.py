@@ -326,6 +326,15 @@ def test_corrupt_wal_node_rejoins_via_install_snapshot(tmp_path):
             assert os.path.exists(
                 str(tmp_path / f"node{victim}" / "raft_wal.jsonl.corrupt")
             )
+            # Blobs share the quarantine (no integrity headers: whatever
+            # corrupted the log may have flipped blob bytes too); a fresh
+            # empty tree replaces them and fetch-on-miss heals reads.
+            assert os.path.exists(
+                str(tmp_path / f"node{victim}" / "uploads.corrupt")
+            )
+            assert os.path.isdir(
+                str(tmp_path / f"node{victim}" / "uploads")
+            )
             await fresh.start()
 
             # More traffic while it heals.

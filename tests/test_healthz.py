@@ -125,9 +125,47 @@ def test_admin_endpoint_roundtrip_and_errors():
                 port, "/admin/membership", {"op": "add", "boom": True}
             )
             assert status == 500
-            # GET to an admin path stays 404 (POST-only plane).
+            # GET to an admin path stays 404 when no read-only handler
+            # is configured (mutations remain POST-only either way).
             status, _ = await _get(port, "/admin/membership")
             assert status == 404
+        finally:
+            await hs.stop()
+
+    asyncio.run(run())
+
+
+def test_admin_get_routes_read_only_introspection():
+    """GET /admin/* dispatches to `admin_get` (read-only plane, e.g.
+    GET /admin/faults); unknown paths 404, ValueErrors 400; POST still
+    routes to the mutating handler."""
+    posts = []
+
+    async def admin(path, body):
+        posts.append((path, body))
+        return {"posted": True}
+
+    async def admin_get(path):
+        if path == "/admin/faults":
+            return {"ok": True, "faults": {"targets": {}}}
+        if path == "/admin/teapot":
+            raise ValueError("short and stout")
+        raise KeyError(path)
+
+    async def run():
+        hs = HealthServer(Metrics(), admin=admin, admin_get=admin_get)
+        port = await hs.start()
+        try:
+            status, body = await _get(port, "/admin/faults")
+            assert status == 200 and body["ok"] and "faults" in body
+            status, body = await _get(port, "/admin/teapot")
+            assert status == 400 and "stout" in body["error"]
+            status, _ = await _get(port, "/admin/nope")
+            assert status == 404
+            # POST keeps hitting the mutating handler, not admin_get.
+            status, body = await _post(port, "/admin/faults", {"x": 1})
+            assert status == 200 and body == {"posted": True}
+            assert posts == [("/admin/faults", {"x": 1})]
         finally:
             await hs.stop()
 

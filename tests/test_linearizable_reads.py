@@ -161,3 +161,59 @@ def test_service_read_fence_refuses_on_follower():
     import tempfile, pathlib
     with tempfile.TemporaryDirectory() as d:
         asyncio.run(run(pathlib.Path(d)))
+
+
+def test_mutation_auth_miss_rechecked_behind_fence():
+    """A committed-but-unapplied Login (the window right after a
+    leadership transfer: the new leader serves before its own-term no-op
+    commits) must not be answered success=False/'invalid session' — the
+    auth miss is re-checked behind the read fence, which resolves only
+    once prior committed entries have applied."""
+    from distributed_lms_raft_llm_tpu.lms.persistence import BlobStore
+    from distributed_lms_raft_llm_tpu.lms.service import LMSServicer
+    from distributed_lms_raft_llm_tpu.lms.state import LMSState
+    from distributed_lms_raft_llm_tpu.proto import lms_pb2
+
+    state = LMSState()
+    state.apply("Register", {"username": "ana", "password_hash": "x",
+                             "role": "student"})
+
+    class LaggedLeader:
+        """The Login entry is in the (committed) log but applies only
+        when the barrier resolves — exactly a fresh leader's state."""
+
+        def __init__(self):
+            self.barriers = 0
+            self.proposed = []
+
+        async def read_barrier(self, timeout: float = 10.0) -> int:
+            self.barriers += 1
+            state.apply("Login", {"username": "ana", "token": "tok"})
+            return 1
+
+        async def propose(self, command, timeout: float = 10.0) -> int:
+            self.proposed.append(command)
+            return 2
+
+    class Ctx:
+        async def abort(self, code, details):  # pragma: no cover - unused
+            raise AssertionError(f"abort({code}): {details}")
+
+    async def run(tmp):
+        node = LaggedLeader()
+        svc = LMSServicer(node, state, BlobStore(str(tmp / "blobs")))
+        req = lms_pb2.PostRequest(token="tok", type="query", data="q?",
+                                  request_id="r1")
+        resp = await svc.Post(req, Ctx())
+        assert resp.success, "apply-lagged session treated as invalid"
+        assert node.barriers == 1, "auth miss must fence exactly once"
+        assert node.proposed, "the query must still commit"
+        # Fast path: a now-visible session pays no extra barrier.
+        resp = await svc.Post(req, Ctx())
+        assert resp.success and node.barriers == 1
+
+    import pathlib
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        asyncio.run(run(pathlib.Path(d)))

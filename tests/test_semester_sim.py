@@ -1,0 +1,269 @@
+"""Semester simulator (sim/): the composed production scenario.
+
+Tier-1 runs ONE seeded sim end-to-end (module-scoped fixture — every
+assertion below reads the same run): >=1 TimeoutNow rolling restart, >=1
+storage-recovery quarantine + rejoin, >=1 membership add/remove, and a
+network-chaos campaign with a tutoring blackout, with SLOs asserted from
+/metrics + /healthz and the acked-write ledger proving zero loss. A
+scaled `slow`-marked soak runs the same harness harder; the wall-budget
+guard keeps the tier-1 run inside its time box.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+from distributed_lms_raft_llm_tpu.config import SimConfig
+from distributed_lms_raft_llm_tpu.sim import (
+    SemesterSim,
+    SimCluster,
+    WorkloadGenerator,
+    plan_events,
+    trace_digest,
+)
+
+# Deliberately small but not trivial: ~90 ops across 12 actors, every
+# event kind, and every SLO — in ~20 s of wall clock.
+TIER1_CFG = SimConfig(
+    seed=7, students=10, instructors=2, courses=2,
+    duration_s=16.0, base_rate=6.0, workers=6, llm_budget_s=10.0,
+    slo_answer_p95_s=8.0, slo_degraded_rate_max=0.5,
+    slo_tick_stalls_max=50,
+)
+
+# The tier-1 sim's time box (the fixture measures the WHOLE run: cluster
+# boot, setup, workload, settle, audit, teardown). The workload phase is
+# 16 s; everything around it has to fit in the remainder. Creeping past
+# this means the sim no longer belongs in tier-1 — trim it or move it.
+TIER1_WALL_BUDGET_S = 90.0
+
+
+@pytest.fixture(scope="module")
+def sim_run(tmp_path_factory):
+    t0 = time.monotonic()
+    record = SemesterSim(
+        TIER1_CFG, str(tmp_path_factory.mktemp("semester"))
+    ).run()
+    return record, time.monotonic() - t0
+
+
+def test_sim_end_to_end_slos_hold(sim_run):
+    """The acceptance scenario: every SLO asserted from the cluster's
+    /metrics + /healthz (and the ledger) holds across the full run."""
+    record, _ = sim_run
+    slos = record["slos"]
+    assert slos["ok"], f"SLO failures: " + str({
+        k: v for k, v in slos["checks"].items() if not v["ok"]
+    })
+    assert slos["checks"]["zero_acked_write_loss"]["ok"]
+    assert record["acked_writes"] > 30, "the run must really write"
+    assert record["ops_ok"] > 0.9 * record["ops_planned"], (
+        "most ops must succeed despite the fault schedule"
+    )
+
+
+def test_sim_executed_every_event_kind(sim_run):
+    """>=1 leadership transfer (rolling restart), >=1 storage-recovery
+    quarantine+rejoin, >=1 membership add AND remove, >=1 chaos
+    campaign — all executed through the real admin plane, none failed."""
+    record, _ = sim_run
+    failed = [e for e in record["events"] if not e["ok"]]
+    assert not failed, f"events failed: {failed}"
+    executed = record["events_executed"]
+    for kind in ("rolling_restart", "quarantine", "membership_add",
+                 "membership_remove", "chaos_campaign"):
+        assert executed.get(kind, 0) >= 1, f"missing event kind {kind}"
+
+
+def test_sim_exercised_degraded_path(sim_run):
+    """The tutoring blackout really produced degraded instructor-queue
+    answers (client-observed: node counters can be wiped by the rolling
+    restart, which is exactly why the sim keeps its own ledger)."""
+    record, _ = sim_run
+    assert record["degraded_answers"] >= 1
+    assert record["asks"] > 10
+
+
+def test_sim_exercised_relevance_gate(sim_run):
+    """The off-topic asks really hit the gate (KeywordGate in the sim
+    cluster): both counters moved on the nodes' /metrics. Sums survive
+    the rolling restart only on never-restarted nodes, so >= 1, not an
+    exact count."""
+    record, _ = sim_run
+    assert record["gate_pass"] >= 1
+    assert record["gate_reject"] >= 1
+
+
+def test_keyword_gate_splits_workload_queries():
+    """Every on-topic query passes against the assignment text and every
+    off-topic one is rejected — with margin, so the threshold is not
+    sitting on a knife edge."""
+    from distributed_lms_raft_llm_tpu.sim.cluster import KeywordGate
+
+    import distributed_lms_raft_llm_tpu.sim.workload as wl
+
+    g = KeywordGate()
+    for q in wl.ON_TOPIC_QUERIES:
+        passed, sim = g.check(q, wl.ASSIGNMENT_TEXT)
+        assert passed and sim >= 2 * g.threshold, (q, sim)
+    for q in wl.OFF_TOPIC_QUERIES:
+        passed, sim = g.check(q, wl.ASSIGNMENT_TEXT)
+        assert not passed and sim == 0.0, (q, sim)
+    # The ops bot's probes must pass against ITS assignment text (a
+    # gated-out settle probe could never re-close a breaker).
+    for probe in ("ops bot probe: what is Raft?", "ops bot settle probe?"):
+        assert g.check(probe, "ops bot assignment")[0], probe
+
+
+def test_sim_record_is_bench_schema(sim_run):
+    """One JSON record, BENCH shape: headline metric + replay anchors."""
+    record, _ = sim_run
+    assert record["metric"] == "semester_sim_ask_p95_s"
+    assert isinstance(record["value"], float)
+    assert record["unit"] == "s"
+    assert record["seed"] == TIER1_CFG.seed
+    # Replayability: digests of the decision-level inputs.
+    gen = WorkloadGenerator(TIER1_CFG)
+    assert record["trace_digest"] == trace_digest(gen.ops())
+
+
+def test_tier1_sim_wall_budget(sim_run):
+    """CI guard: the tier-1 sim must stay inside its time box."""
+    _, wall = sim_run
+    assert wall < TIER1_WALL_BUDGET_S, (
+        f"tier-1 semester sim took {wall:.1f}s (budget "
+        f"{TIER1_WALL_BUDGET_S}s) — trim the config or demote it to slow"
+    )
+
+
+# ------------------------------------------------------ seeded determinism
+
+
+def test_same_seed_same_trace_and_schedule():
+    """Replayability contract: the op trace and the event schedule are
+    pure functions of the config (seed included)."""
+    a = WorkloadGenerator(TIER1_CFG).ops()
+    b = WorkloadGenerator(TIER1_CFG).ops()
+    assert [o.key() for o in a] == [o.key() for o in b]
+    assert trace_digest(a) == trace_digest(b)
+    assert [e.key() for e in plan_events(TIER1_CFG)] == [
+        e.key() for e in plan_events(TIER1_CFG)
+    ]
+
+
+def test_different_seed_different_trace():
+    other = dataclasses.replace(TIER1_CFG, seed=TIER1_CFG.seed + 1)
+    assert trace_digest(WorkloadGenerator(TIER1_CFG).ops()) != trace_digest(
+        WorkloadGenerator(other).ops()
+    )
+    assert [e.key() for e in plan_events(TIER1_CFG)] != [
+        e.key() for e in plan_events(other)
+    ]
+
+
+def test_sim_config_rejects_degenerate_shapes():
+    """Bad [sim] values fail at load like every other section — not as
+    ZeroDivisionError/IndexError minutes into a run."""
+    for bad in ({"courses": 0}, {"instructors": 0}, {"base_rate": 0.0},
+                {"students": 0}, {"workers": 0}, {"duration_s": 0.0}):
+        with pytest.raises(ValueError):
+            dataclasses.replace(TIER1_CFG, **bad)
+
+
+def test_diurnal_curve_shapes_the_trace():
+    """The load really follows the day: the midday half of the run must
+    carry more ops than the edges (amplitude 0 flattens it)."""
+    cfg = dataclasses.replace(TIER1_CFG, duration_s=60.0, base_rate=12.0,
+                              diurnal_amplitude=0.9)
+    ops = WorkloadGenerator(cfg).ops()
+    mid = sum(1 for o in ops if 15.0 <= o.at_s < 45.0)
+    edges = len(ops) - mid
+    assert mid > 1.3 * edges, (mid, edges)
+
+
+# ------------------------------------------- fault/campaign introspection
+
+
+def test_admin_faults_get_reports_campaigns(tmp_path):
+    """Satellite: GET /admin/faults (the plane was write-only) returns
+    the live fault + campaign configuration; campaigns install, report,
+    and clear their specs."""
+    cfg = dataclasses.replace(TIER1_CFG, events=False)
+    cluster = SimCluster(str(tmp_path), cfg, nodes=1)
+    cluster.start()
+    try:
+        nid = cluster.node_ids()[0]
+        state = cluster.admin_get(nid, "/admin/faults")
+        assert state["ok"] and state["faults"]["targets"] == {}
+        assert state["campaign"]["active"] is False
+
+        # One-shot spec shows up in the GET.
+        cluster.admin_post(nid, "/admin/faults",
+                           {"target": "tutoring", "drop": 0.5})
+        state = cluster.admin_get(nid, "/admin/faults")
+        assert state["faults"]["targets"]["tutoring"]["drop"] == 0.5
+
+        # A campaign: phase visible while live, spec installed, and both
+        # gone once cancelled.
+        cluster.admin_post(nid, "/admin/faults", {"campaign": {
+            "name": "introspection",
+            "phases": [{"target": "*", "duration_s": 30.0, "drop": 0.25}],
+        }})
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            state = cluster.admin_get(nid, "/admin/faults")
+            if "*" in state["faults"]["targets"]:
+                break
+            time.sleep(0.05)
+        assert state["campaign"]["active"] is True
+        assert state["campaign"]["name"] == "introspection"
+        assert state["campaign"]["phase"]["drop"] == 0.25
+        assert state["faults"]["targets"]["*"]["drop"] == 0.25
+
+        # The cancel POST's own response is authoritative: the teardown
+        # has landed by the time it returns (CampaignRunner.stop), so no
+        # polling — a stranded spec here is a regression.
+        state = cluster.admin_post(nid, "/admin/faults",
+                                   {"campaign_cancel": True})
+        assert state["campaign"]["active"] is False
+        assert "*" not in state["faults"]["targets"], (
+            "cancelled campaign stranded its spec"
+        )
+
+        # Unknown spec fields in a campaign fail the POST up front.
+        with pytest.raises(RuntimeError, match="unknown fault field"):
+            cluster.admin_post(nid, "/admin/faults", {"campaign": {
+                "name": "typo",
+                "phases": [{"target": "*", "duration_s": 1.0, "dorp": 1.0}],
+            }})
+        # GET of an unknown admin path is a 404, not a crash.
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError):
+            cluster.admin_get(nid, "/admin/nope")
+    finally:
+        cluster.stop()
+
+
+# ------------------------------------------------------------ tier-2 soak
+
+
+@pytest.mark.slow
+def test_semester_sim_soak_scaled(tmp_path):
+    """The same harness at scale: more students, longer semester, the
+    REAL tiny JAX engine behind tutoring, and tighter stall bounds."""
+    cfg = SimConfig(
+        seed=11, students=48, instructors=4, courses=4,
+        duration_s=90.0, base_rate=10.0, workers=12, llm_budget_s=15.0,
+        tutoring_engine="tiny",
+        slo_answer_p95_s=15.0, slo_degraded_rate_max=0.5,
+        slo_tick_stalls_max=200,
+    )
+    record = SemesterSim(cfg, str(tmp_path)).run()
+    assert record["slos"]["ok"], record["slos"]
+    assert not [e for e in record["events"] if not e["ok"]]
+    for kind in ("rolling_restart", "quarantine", "membership_add",
+                 "membership_remove", "chaos_campaign"):
+        assert record["events_executed"].get(kind, 0) >= 1
+    assert record["acked_writes"] > 150

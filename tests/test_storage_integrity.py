@@ -396,6 +396,38 @@ def test_recovery_mode_survives_restart_via_marker(tmp_path):
     assert not node3.recovering
 
 
+def test_marker_resume_quarantines_blob_tree(tmp_path):
+    """A crash between the WAL/snapshot renames and the uploads rename
+    leaves the (possibly bit-flipped) blob tree live while the log loads
+    clean — the corruption handler never runs on the next boot, so the
+    marker-resume path must quarantine the blobs itself or the healed
+    node serves corrupt bytes (blobs carry no checksums)."""
+    data_dir = _seed_node_state(tmp_path)
+    _corrupt_midfile(os.path.join(data_dir, "raft_wal.jsonl"))
+    node = LMSNode(1, {1: "", 2: "", 3: ""}, data_dir,
+                   transport=MemNetwork().transport_for(1))
+    assert node.recovering
+    # Recreate the crash window: a stale blob sits under the LIVE uploads
+    # path while marker + clean stores say "resume recovery".
+    blob = os.path.join(data_dir, "uploads", "materials", "week1.pdf")
+    os.makedirs(os.path.dirname(blob), exist_ok=True)
+    with open(blob, "wb") as fh:
+        fh.write(b"possibly bit-flipped bytes")
+    node2 = LMSNode(1, {1: "", 2: "", 3: ""}, data_dir,
+                    transport=MemNetwork().transport_for(1))
+    assert node2.recovering
+    assert not os.path.exists(blob), (
+        "marker-resume boot left the stale blob tree live"
+    )
+    quarantined = [
+        d for d in os.listdir(data_dir)
+        if d.startswith("uploads.corrupt")
+        and os.path.exists(os.path.join(data_dir, d, "materials",
+                                        "week1.pdf"))
+    ]
+    assert quarantined, "stale blob tree was deleted, not quarantined"
+
+
 def test_storage_config_rejects_typod_policies(tmp_path):
     """`fsync = "on"` must fail at load, not silently disable fsync."""
     from distributed_lms_raft_llm_tpu.config import load_config
