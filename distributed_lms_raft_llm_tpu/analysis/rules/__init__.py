@@ -26,5 +26,6 @@ from . import (  # noqa: F401
     program_inventory,
     pspec_flow,
     slow_marker,
+    trace_propagation,
     tracer_hygiene,
 )

@@ -1,0 +1,138 @@
+"""trace-propagation: handler-reachable stub egress must forward the
+request's trace context.
+
+The flight recorder (utils/tracing.py) reconstructs one request's journey
+across processes by riding an `x-trace-context` metadata header on every
+gRPC hop. That chain is only as strong as its weakest egress: ONE stub
+call built with bare metadata (or none) and every span downstream of it
+re-roots as an orphan fragment — the waterfall silently loses the engine
+spans, which is precisely the part of the 1.69 s p50 every perf PR needs
+to see. Silent, because nothing errors: traces just come back shallower.
+
+This rule makes the chain structural, the same way deadline-flow made
+budget propagation structural: **every awaited gRPC stub egress reachable
+from an RPC handler in the request-path modules (`lms/`, `serving/`) must
+build its metadata through `trace_metadata(...)`** — the one sanctioned
+wrapper, which appends the current span's context to whatever base
+metadata the call already carries.
+
+Mechanics (analysis/project.py, shared with deadline-flow):
+
+- roots are the async methods of `*Servicer` subclasses plus every
+  address-taken function (the post-commit replication sweep is reached
+  through `apply_cb=self._apply`);
+- reachability is the call-graph closure over those roots;
+- a "stub egress" is an **awaited** method call whose attribute is
+  CamelCase — the proto naming convention separating wire RPCs
+  (`FetchFile`, `GetLLMAnswer`) from snake_case helpers; the await
+  requirement keeps protobuf constructors (`lms_pb2.FetchFileRequest`,
+  also CamelCase, never awaited) out of scope;
+- the finding fires when the call has no `metadata=` keyword, or one
+  whose value is not a direct `trace_metadata(...)` call. Wrapping the
+  existing expression (`metadata=trace_metadata(deadline.to_metadata())`)
+  is the fix shape and never flags.
+
+Raft-internal RPCs (`raft/grpc_transport.py`) are deliberately out of
+scope: heartbeats and appends are protocol traffic, not request traffic —
+tracing them would churn the ring and say nothing a request-scoped
+`raft.commit` span doesn't (see the tracing module docstring).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from ..core import Finding, register
+from ..project import Project, ProjectRule
+
+# Request-path modules: where request-scoped trace context lives.
+DEFAULT_WATCH = (
+    "distributed_lms_raft_llm_tpu/lms/",
+    "distributed_lms_raft_llm_tpu/serving/",
+)
+
+# The sanctioned metadata-building wrapper (utils/tracing.py).
+WRAPPER = "trace_metadata"
+
+
+def _awaited_stub_egress(node: ast.Await) -> Optional[ast.Call]:
+    """The awaited Call when `node` awaits a CamelCase-method stub RPC."""
+    call = node.value
+    if not isinstance(call, ast.Call):
+        return None
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr[:1].isupper():
+        return call
+    return None
+
+
+def _metadata_kw(call: ast.Call) -> Optional[ast.keyword]:
+    for kw in call.keywords:
+        if kw.arg == "metadata":
+            return kw
+    return None
+
+
+def _is_wrapper_call(expr: ast.expr) -> bool:
+    """`trace_metadata(...)` (bare or module-qualified)."""
+    if not isinstance(expr, ast.Call):
+        return False
+    func = expr.func
+    if isinstance(func, ast.Name):
+        return func.id == WRAPPER
+    if isinstance(func, ast.Attribute):
+        return func.attr == WRAPPER
+    return False
+
+
+@register
+class TracePropagationRule(ProjectRule):
+    name = "trace-propagation"
+    description = (
+        "gRPC stub egress reachable from an RPC handler whose metadata is "
+        "not built via utils.tracing.trace_metadata(...) — the request's "
+        "x-trace-context is dropped and every downstream span re-roots as "
+        "an orphan fragment; wrap the existing metadata expression"
+    )
+
+    def __init__(self, watch_prefixes: Sequence[str] = DEFAULT_WATCH):
+        self.watch_prefixes = tuple(watch_prefixes)
+
+    def check_project(self, project: Project) -> List[Finding]:
+        roots = project.handler_roots() | project.address_taken
+        reachable = project.reachable(roots)
+        findings: List[Finding] = []
+        seen = set()
+        for fn in project.functions_in(self.watch_prefixes):
+            if fn.qname not in reachable:
+                continue
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Await):
+                    continue
+                call = _awaited_stub_egress(node)
+                if call is None:
+                    continue
+                rpc = call.func.attr  # type: ignore[union-attr]
+                kw = _metadata_kw(call)
+                if kw is not None and _is_wrapper_call(kw.value):
+                    continue
+                # col_offset keeps two egresses sharing a line distinct;
+                # the dedup collapses only the nested-def re-walk.
+                key = (fn.rel, call.lineno, call.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                what = (
+                    "carries metadata that bypasses trace_metadata()"
+                    if kw is not None else "sends no metadata at all"
+                )
+                findings.append(self.finding(
+                    fn.src, call,
+                    f"{rpc}(...) is reachable from an RPC handler but "
+                    f"{what} — the x-trace-context chain breaks here and "
+                    "every downstream span re-roots as an orphan "
+                    "fragment; build the metadata with utils.tracing."
+                    "trace_metadata(<existing metadata or None>)",
+                ))
+        return findings

@@ -34,6 +34,8 @@ from ..utils.resilience import (
     DeadlineExpired,
     jittered_backoff,
 )
+from ..utils.tracing import FLAG_DEADLINE, FLAG_ERROR, get_tracer, \
+    trace_metadata
 
 log = logging.getLogger(__name__)
 
@@ -207,6 +209,8 @@ class LMSClient:
         *,
         budget_s: Optional[float] = None,
         attempt_cap_s: Optional[float] = -1.0,
+        route: str = "call",
+        trace_id: Optional[str] = None,
     ) -> T:
         """Run an op against the leader under one overall deadline.
 
@@ -226,6 +230,23 @@ class LMSClient:
         # means "let one attempt use the whole remaining budget" (ask_llm,
         # where generation legitimately outlasts control-plane RPCs).
         cap = self.rpc_timeout if attempt_cap_s == -1.0 else attempt_cap_s
+        # ONE client span covers the whole logical op — discovery, every
+        # retry, the backoffs between them. Server-side fragments graft
+        # under it via the x-trace-context each attempt carries (_md), and
+        # mutating ops reuse their idempotency id as the trace id, so
+        # `/admin/trace/<request-id>` answers for the id already in logs.
+        with get_tracer().trace(f"client.{route}",
+                                trace_id=trace_id) as root:
+            return self._attempts(fn, deadline, cap, budget_s, root)
+
+    def _attempts(
+        self,
+        fn: Callable[[rpc.LMSStub, float, Optional[Deadline]], T],
+        deadline: Deadline,
+        cap: Optional[float],
+        budget_s: Optional[float],
+        root,
+    ) -> T:
         last_error: Optional[Exception] = None
         avoid: Optional[str] = None
         for attempt in range(self.rpc_retries + 1):
@@ -263,7 +284,9 @@ class LMSClient:
                 if sleep_s > 0:
                     time.sleep(sleep_s)
         if last_error is not None:
+            root.flag(FLAG_ERROR)
             raise last_error
+        root.flag(FLAG_DEADLINE)
         raise DeadlineExpired(
             f"request budget ({budget_s or self.request_timeout_s:.1f}s) "
             "exhausted before the first attempt"
@@ -283,7 +306,9 @@ class LMSClient:
         md = deadline.to_metadata() if deadline is not None else []
         if request_id:
             md = md + [(REQUEST_ID_METADATA_KEY, request_id)]
-        return md or None
+        # The trace context rides the same metadata: each attempt carries
+        # the client span's position so server fragments graft under it.
+        return trace_metadata(md)
 
     # ----------------------------------------------------------------- api
 
@@ -294,7 +319,8 @@ class LMSClient:
                     username=username, password=password, role=role
                 ),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="register",
         )
 
     def login(self, username: str, password: str) -> bool:
@@ -302,7 +328,8 @@ class LMSClient:
             lambda s, t, d: s.Login(
                 lms_pb2.LoginRequest(username=username, password=password),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="login",
         )
         if resp.success:
             self.token = resp.token
@@ -316,7 +343,8 @@ class LMSClient:
             lambda s, t, d: s.Logout(
                 lms_pb2.LogoutRequest(token=self.token), timeout=t,
                 metadata=self._md(d),
-            )
+            ),
+            route="logout",
         )
         if resp.success:
             self.token = None
@@ -332,7 +360,8 @@ class LMSClient:
                     file=content, filename=filename, request_id=rid,
                 ),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="upload_assignment", trace_id=rid,
         ).success
 
     def upload_course_material(self, filename: str, content: bytes) -> bool:
@@ -344,7 +373,8 @@ class LMSClient:
                     file=content, filename=filename, request_id=rid,
                 ),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="upload_course_material", trace_id=rid,
         ).success
 
     def ask_instructor(self, query: str) -> bool:
@@ -356,7 +386,8 @@ class LMSClient:
                     request_id=rid,
                 ),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="ask_instructor", trace_id=rid,
         ).success
 
     def course_materials(self) -> List[lms_pb2.DataEntry]:
@@ -364,7 +395,8 @@ class LMSClient:
             lambda s, t, d: s.Get(
                 lms_pb2.GetRequest(token=self.token or "", type="course_material"),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="course_materials",
         )
         return list(resp.entries)
 
@@ -373,7 +405,8 @@ class LMSClient:
             lambda s, t, d: s.Get(
                 lms_pb2.GetRequest(token=self.token or "", type="student_list"),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="student_assignments",
         )
         return list(resp.entries)
 
@@ -386,7 +419,8 @@ class LMSClient:
                     request_id=rid,
                 ),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="grade", trace_id=rid,
         )
 
     def my_grade(self) -> str:
@@ -394,7 +428,8 @@ class LMSClient:
             lambda s, t, d: s.GetGrade(
                 lms_pb2.GetGradeRequest(token=self.token or ""),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="my_grade",
         )
         return resp.grade
 
@@ -403,7 +438,8 @@ class LMSClient:
             lambda s, t, d: s.GetUnansweredQueries(
                 lms_pb2.GetRequest(token=self.token or ""),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="unanswered_queries",
         )
         return list(resp.entries)
 
@@ -416,7 +452,8 @@ class LMSClient:
                     request_id=rid,
                 ),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="respond_to_query", trace_id=rid,
         ).success
 
     def instructor_responses(self) -> List[lms_pb2.DataEntry]:
@@ -424,12 +461,14 @@ class LMSClient:
             lambda s, t, d: s.GetInstructorResponse(
                 lms_pb2.GetRequest(token=self.token or ""),
                 timeout=t, metadata=self._md(d),
-            )
+            ),
+            route="instructor_responses",
         )
         return list(resp.entries)
 
     def ask_llm(
-        self, query: str, *, budget_s: Optional[float] = None
+        self, query: str, *, budget_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> lms_pb2.QueryResponse:
         """One student query under one overall budget (default
         `llm_timeout_s`). The LMS forwards the remaining budget to the
@@ -438,8 +477,10 @@ class LMSClient:
 
         One `request_id` spans ALL retries of this logical call: a retry
         whose earlier attempt already queued the degraded instructor entry
-        must not queue a second one (ROADMAP item a)."""
-        rid = self._request_id()
+        must not queue a second one (ROADMAP item a). It doubles as the
+        TRACE id — `GET /admin/trace/<request_id>` returns this call's
+        span tree — and callers may supply their own (pre-logged) id."""
+        rid = request_id or self._request_id()
         return self._call(
             lambda s, t, d: s.GetLLMAnswer(
                 lms_pb2.QueryRequest(token=self.token or "", query=query),
@@ -447,4 +488,5 @@ class LMSClient:
             ),
             budget_s=budget_s or self.llm_timeout_s,
             attempt_cap_s=None,
+            route="ask_llm", trace_id=rid,
         )

@@ -214,6 +214,31 @@ class SimConfig:
 
 
 @dataclasses.dataclass
+class TracingConfig:
+    """[tracing] — the flight-recorder request tracer (utils/tracing.py).
+    One section because the knobs trade off as a unit: the ring bounds
+    steady-state memory, the exemplar/flagged pins decide which traces
+    survive eviction, and the span cap bounds a single runaway request.
+    """
+
+    enabled: bool = True          # span collection + x-trace-context headers
+    ring_size: int = 256          # retained traces (beyond pins); oldest out
+    exemplars_per_route: int = 4  # slowest-N pinned per route
+    flagged_max: int = 64         # pinned degraded/error/deadline traces
+    max_spans_per_trace: int = 512  # per-trace span cap (then 'truncated')
+
+    def __post_init__(self) -> None:
+        if self.ring_size < 1 or self.max_spans_per_trace < 1:
+            raise ValueError(
+                "[tracing] ring_size and max_spans_per_trace must be >= 1"
+            )
+        if self.exemplars_per_route < 0 or self.flagged_max < 0:
+            raise ValueError(
+                "[tracing] exemplars_per_route and flagged_max must be >= 0"
+            )
+
+
+@dataclasses.dataclass
 class AppConfig:
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     tutoring: TutoringConfig = dataclasses.field(default_factory=TutoringConfig)
@@ -224,6 +249,7 @@ class AppConfig:
     )
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     sim: SimConfig = dataclasses.field(default_factory=SimConfig)
+    tracing: TracingConfig = dataclasses.field(default_factory=TracingConfig)
 
     @property
     def client_servers(self) -> List[str]:
@@ -246,7 +272,7 @@ def load_config(path: str) -> AppConfig:
     with open(path, "rb") as fh:
         raw = tomllib.load(fh)
     unknown = set(raw) - {"cluster", "tutoring", "sampling", "gate",
-                          "resilience", "storage", "sim"}
+                          "resilience", "storage", "sim", "tracing"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -268,6 +294,8 @@ def load_config(path: str) -> AppConfig:
         storage=_build(StorageConfig, dict(raw.get("storage", {})),
                        "storage"),
         sim=_build(SimConfig, dict(raw.get("sim", {})), "sim"),
+        tracing=_build(TracingConfig, dict(raw.get("tracing", {})),
+                       "tracing"),
     )
 
 

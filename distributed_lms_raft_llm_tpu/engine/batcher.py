@@ -23,16 +23,35 @@ computes answers that can still be delivered.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils import metrics_registry as metric
 from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
+from ..utils.tracing import FLAG_DEADLINE, NULL_SPAN
 
 log = logging.getLogger(__name__)
 
-# Queue items: (prompt, deadline-or-None, result future).
-_Item = Tuple[str, Optional[Deadline], asyncio.Future]
+# Queue items: (prompt, deadline-or-None, result future, request span,
+# its open queue.wait child). Spans are NULL_SPAN when the request
+# entered through an untraced edge, so the scheduling code never
+# branches on tracing.
+_Item = Tuple[str, Optional[Deadline], asyncio.Future, Any, Any]
+
+
+def _observe_program_times(metrics, entries) -> None:
+    """Feed engine-reported (program, start_unix, wall_s) dispatch times
+    into the per-program histogram series. Unknown program names are
+    skipped (an engine may report more detail than the registry names)."""
+    if metrics is None:
+        return
+    for pname, _start, wall_s in entries:
+        if pname in metric.ENGINE_PROGRAM_HISTOGRAMS:
+            metrics.hist(
+                metric.ENGINE_PROGRAM_HISTOGRAMS[pname]
+            ).observe(wall_s)
 
 
 class BatchingQueue:
@@ -85,17 +104,24 @@ class BatchingQueue:
         # Fail fast for anything still waiting (queued requests, or a group
         # whose device batch was cancelled mid-flight) instead of hanging.
         while not self._queue.empty():
-            _, _, fut = self._queue.get_nowait()
+            _, _, fut, _, qspan = self._queue.get_nowait()
+            qspan.end()
             if not fut.done():
                 fut.set_exception(RuntimeError("batching queue closed"))
 
     async def submit(self, prompt: str,
-                     deadline: Optional[Deadline] = None) -> str:
+                     deadline: Optional[Deadline] = None,
+                     span: Any = None) -> str:
         """Enqueue one query; resolves with its decoded answer.
 
         Raises `Overloaded` when the bounded queue is full and
         `DeadlineExpired` when the budget is already gone — both *before*
         the request occupies a queue slot.
+
+        `span` is the request's trace span (utils/tracing.py): the queue
+        records `queue.wait` (enqueue -> device dispatch) and
+        `engine.batch` children under it, with the engine's per-program
+        dispatch times as grandchildren.
         """
         if self._closed:
             raise RuntimeError("batching queue is closed")
@@ -107,8 +133,11 @@ class BatchingQueue:
             raise Overloaded(
                 f"tutoring queue full ({self._queue.qsize()} waiting)"
             )
+        span = span if span is not None else NULL_SPAN
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((prompt, deadline, fut))
+        await self._queue.put(
+            (prompt, deadline, fut, span, span.child("queue.wait"))
+        )
         return await fut
 
     async def _collect(self) -> List[_Item]:
@@ -133,9 +162,11 @@ class BatchingQueue:
         exact device time an overloaded server is short of."""
         live: List[_Item] = []
         for item in group:
-            _, dl, fut = item
+            _, dl, fut, span, qspan = item
             if dl is not None and dl.expired:
                 self._inc("shed_expired")
+                qspan.end()
+                span.flag(FLAG_DEADLINE)
                 if not fut.done():
                     fut.set_exception(
                         DeadlineExpired("expired while queued; prefill skipped")
@@ -150,7 +181,17 @@ class BatchingQueue:
             group = self._drop_expired(await self._collect())
             if not group:
                 continue  # everything expired while queued: zero prefills
-            prompts = [p for p, _, _ in group]
+            prompts = [p for p, _, _, _, _ in group]
+            # Dispatch moment: queue.wait ends, engine.batch begins, for
+            # every request of the group (per-request spans under each
+            # request's own parent; the device batch is shared).
+            espans = []
+            for _, _, _, span, qspan in group:
+                qspan.end()
+                espans.append(
+                    span.child("engine.batch", batch=len(group))
+                )
+            t_batch_unix = time.time()
             try:
                 # The engine call blocks on device compute; run it off-loop so
                 # new requests keep queueing meanwhile.
@@ -159,17 +200,31 @@ class BatchingQueue:
                     None, self.engine.answer_batch, prompts
                 )
             except asyncio.CancelledError:
-                # close() mid-batch: resolve the in-flight group before dying.
-                for _, _, fut in group:
+                # close() mid-batch: resolve the in-flight group before
+                # dying. Drop any program times the dying batch already
+                # recorded so they can't leak into a later queue's traces.
+                pop = getattr(self.engine, "pop_program_times", None)
+                if pop is not None:
+                    pop()
+                for espan in espans:
+                    espan.end()
+                for _, _, fut, _, _ in group:
                     if not fut.done():
                         fut.set_exception(RuntimeError("batching queue closed"))
                 raise
             except Exception as e:  # resolve all waiters with the failure
                 log.exception("batch of %d failed", len(prompts))
-                for _, _, fut in group:
+                for espan in espans:
+                    espan.set_status("error")
+                # Drain the partial dispatches under THIS failed batch's
+                # spans (they happened here) — leaving them queued would
+                # misattribute them to the next batch's traces.
+                self._finish_engine_spans(espans, t_batch_unix)
+                for _, _, fut, _, _ in group:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
+            self._finish_engine_spans(espans, t_batch_unix)
             # The engine measures time-to-first-token between its prefill and
             # decode programs, per device chunk (requests in later chunks of
             # an oversized group include their queueing delay).
@@ -185,9 +240,46 @@ class BatchingQueue:
                     # verify window (1.0 = nothing accepted). A gauge —
                     # it is a ratio, not a latency.
                     self.metrics.set_gauge("spec_tokens_per_window", tpw)
-            for (_, _, fut), answer in zip(group, answers):
+            for (_, _, fut, _, _), answer in zip(group, answers):
                 if not fut.done():
                     fut.set_result(answer)
+
+    def _finish_engine_spans(self, espans: List[Any],
+                             t_batch_unix: float) -> None:
+        """Close the group's engine spans, grafting the engine's reported
+        per-program dispatch times under each as `engine.<program>`
+        children (one measurement, mirrored under every request that
+        shared the device batch). Engines without the program-times
+        contract get one synthetic `engine.answer_batch` child covering
+        the whole call, so a trace always shows where device time went."""
+        pop = getattr(self.engine, "pop_program_times", None)
+        entries = pop() if pop is not None else []
+        _observe_program_times(self.metrics, entries)
+        for espan in espans:
+            espan.end()
+            if entries:
+                for pname, start_unix, wall_s in entries:
+                    espan.child_timed(f"engine.{pname}", start_unix, wall_s)
+            else:
+                espan.child_timed("engine.answer_batch", t_batch_unix,
+                                  espan.duration_s or 0.0)
+
+
+@dataclasses.dataclass
+class _ReqTrace:
+    """Per-request trace state a paged request carries from admission to
+    completion. Continuous batching has no per-request device batch, so
+    the engine span is synthesized at completion (admission -> last
+    token) and per-program dispatch times are attributed as SHARED
+    aggregates: every program dispatched while the request was in
+    flight (diff of `prog_snapshot` against the queue's accumulator)."""
+
+    span: Any                 # the request's trace span (or NULL_SPAN)
+    qspan: Any                # its open queue.wait child
+    submitted_mono: float
+    submitted_unix: float
+    queued_s: float           # filled once the engine reports the wait
+    prog_snapshot: Dict[str, Tuple[float, float]]
 
 
 class PagedQueue:
@@ -214,6 +306,10 @@ class PagedQueue:
         # rid -> deadline for requests sitting in the ENGINE's pending list
         # (handed over by _admit but no slot yet — prefill hasn't run).
         self._pending_deadlines: Dict[int, Deadline] = {}  # guarded-by: event-loop
+        self._spans: Dict[int, _ReqTrace] = {}       # guarded-by: event-loop
+        # Cumulative per-program (count, wall_s) since queue start; each
+        # request snapshots it at submit and diffs at completion.
+        self._prog_cum: Dict[str, List[float]] = {}  # guarded-by: event-loop
         self._runner: Optional[asyncio.Task] = None  # guarded-by: event-loop
         self._closed = False                         # guarded-by: event-loop
 
@@ -244,17 +340,22 @@ class PagedQueue:
                 pass
             self._runner = None
         while not self._incoming.empty():
-            _, _, fut = self._incoming.get_nowait()
+            _, _, fut, _, qspan = self._incoming.get_nowait()
+            qspan.end()
             if not fut.done():
                 fut.set_exception(RuntimeError("paged queue closed"))
         for fut in self._futures.values():
             if not fut.done():
                 fut.set_exception(RuntimeError("paged queue closed"))
+        for entry in self._spans.values():
+            entry.qspan.end()
         self._futures.clear()
         self._pending_deadlines.clear()
+        self._spans.clear()
 
     async def submit(self, prompt: str,
-                     deadline: Optional[Deadline] = None) -> str:
+                     deadline: Optional[Deadline] = None,
+                     span: Any = None) -> str:
         if self._closed:
             raise RuntimeError("paged queue is closed")
         if deadline is not None and deadline.expired:
@@ -265,16 +366,21 @@ class PagedQueue:
             raise Overloaded(
                 f"paged admission queue full ({self.waiting} waiting)"
             )
+        span = span if span is not None else NULL_SPAN
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._incoming.put((prompt, deadline, fut))
+        await self._incoming.put(
+            (prompt, deadline, fut, span, span.child("queue.wait"))
+        )
         return await fut
 
     def _admit(self, prompt: str, deadline: Optional[Deadline],
-               fut: asyncio.Future) -> None:
+               fut: asyncio.Future, span: Any, qspan: Any) -> None:
         # Shed before prefill: a queue-expired request never enters the
         # engine (its prefill chunk is the expensive step).
         if deadline is not None and deadline.expired:
             self._inc("shed_expired")
+            qspan.end()
+            span.flag(FLAG_DEADLINE)
             if not fut.done():
                 fut.set_exception(
                     DeadlineExpired("expired while queued; prefill skipped")
@@ -282,13 +388,19 @@ class PagedQueue:
             return
         rid = self.engine.submit(prompt)
         self._futures[rid] = fut
+        self._spans[rid] = _ReqTrace(span, qspan, time.monotonic(),
+                                     time.time(), 0.0,
+                                     self._prog_snapshot())
         if deadline is not None:
             self._pending_deadlines[rid] = deadline
 
+    def _prog_snapshot(self) -> Dict[str, Tuple[float, float]]:
+        return {k: (v[0], v[1]) for k, v in self._prog_cum.items()}
+
     def _drain_incoming(self) -> None:
         while not self._incoming.empty():
-            prompt, deadline, fut = self._incoming.get_nowait()
-            self._admit(prompt, deadline, fut)
+            prompt, deadline, fut, span, qspan = self._incoming.get_nowait()
+            self._admit(prompt, deadline, fut, span, qspan)
 
     def _shed_expired_pending(self) -> None:
         """Requests that expired while backlogged in the engine's pending
@@ -305,6 +417,10 @@ class PagedQueue:
                 self._pending_deadlines.pop(rid, None)
                 fut = self._futures.pop(rid, None)
                 self._inc("shed_expired")
+                entry = self._spans.pop(rid, None)
+                if entry is not None:
+                    entry.span.flag(FLAG_DEADLINE)
+                    entry.qspan.end()
                 if fut is not None and not fut.done():
                     fut.set_exception(DeadlineExpired(
                         "expired while backlogged; prefill skipped"
@@ -319,8 +435,8 @@ class PagedQueue:
         while True:
             # Idle: block until a request arrives, then admit it plus any
             # companions that queued behind it.
-            prompt, deadline, fut = await self._incoming.get()
-            self._admit(prompt, deadline, fut)
+            prompt, deadline, fut, span, qspan = await self._incoming.get()
+            self._admit(prompt, deadline, fut, span, qspan)
             while self.engine.has_work:
                 self._drain_incoming()
                 self._shed_expired_pending()
@@ -337,12 +453,17 @@ class PagedQueue:
                     for f in self._futures.values():
                         if not f.done():
                             f.set_exception(e)
+                    for entry in self._spans.values():
+                        entry.span.set_status("error")
+                        entry.qspan.end()
                     self._futures.clear()
                     self._pending_deadlines.clear()
+                    self._spans.clear()
                     # A failed step may have donated the live state away;
                     # rebuild it or every later request fails too.
                     self.engine.reset()
                     break
+                self._reap_observability()
                 ttfts = self.engine.pop_ttfts()
                 if self.metrics is not None:
                     for ttft in ttfts.values():
@@ -365,6 +486,59 @@ class PagedQueue:
                             )
                 for rid, text in done:
                     self._pending_deadlines.pop(rid, None)
+                    self._finish_span(rid)
                     f = self._futures.pop(rid, None)
                     if f is not None and not f.done():
                         f.set_result(text)
+
+    def _reap_observability(self) -> None:
+        """Between steps: drain the engine's measured queue waits (closing
+        the matching `queue.wait` spans with the true submit->prefill
+        interval) and per-program dispatch times (feeding the
+        `engine_prog_*` histogram series and the shared-attribution
+        accumulator the completion-time engine spans diff against)."""
+        pop_waits = getattr(self.engine, "pop_queue_waits", None)
+        if pop_waits is not None:
+            for rid, wait_s in pop_waits().items():
+                entry = self._spans.get(rid)
+                if entry is None:
+                    continue
+                entry.qspan.end(duration_s=wait_s)
+                entry.queued_s = wait_s
+        pop_progs = getattr(self.engine, "pop_program_times", None)
+        if pop_progs is not None:
+            entries = pop_progs()
+            _observe_program_times(self.metrics, entries)
+            for pname, _start, wall_s in entries:
+                cum = self._prog_cum.setdefault(pname, [0.0, 0.0])
+                cum[0] += 1.0
+                cum[1] += wall_s
+
+    def _finish_span(self, rid: int) -> None:
+        """Synthesize the request's `engine.decode` span: admission (end
+        of queue wait) -> last token. Continuous batching shares every
+        dispatched program across the whole running batch, so per-program
+        attribution is the AGGREGATE of dispatches that ran while this
+        request was in flight (`shared: true` on the children), clamped
+        into the parent so the waterfall still nests."""
+        entry = self._spans.pop(rid, None)
+        if entry is None:
+            return
+        # Idempotent: a no-op when the reap already closed the wait span.
+        entry.qspan.end()
+        queued_s = entry.queued_s
+        t_unix = entry.submitted_unix
+        total_s = max(0.0,
+                      time.monotonic() - entry.submitted_mono - queued_s)
+        espan = entry.span.child_timed("engine.decode", t_unix + queued_s,
+                                       total_s)
+        for pname, cum in sorted(self._prog_cum.items()):
+            before = entry.prog_snapshot.get(pname, (0.0, 0.0))
+            n = int(cum[0] - before[0])
+            wall_s = cum[1] - before[1]
+            if n <= 0:
+                continue
+            espan.child_timed(
+                f"engine.{pname}", t_unix + queued_s,
+                min(wall_s, total_s), shared=True, dispatches=n,
+            )

@@ -239,7 +239,19 @@ class TutoringEngine:
         # Tokens produced through answer_batch (bench harnesses divide by
         # wall clock for tokens/sec through the serving path).
         self.total_generated_tokens = 0
+        # (program, wall-clock start, seconds) per answer_batch device
+        # batch, drained by the serving queue into per-program histogram
+        # series and `engine.<program>` trace spans (bounded; see
+        # PagedEngine._prog_times for the paged counterpart).
+        self._prog_times: List[Tuple[str, float, float]] = []
         self._score_fn = None  # built lazily on first score() call
+
+    _PROG_TIMES_MAX = 1024
+
+    def pop_program_times(self) -> List[Tuple[str, float, float]]:
+        """Drain (program, start_unix, wall_s) recorded since last call."""
+        out, self._prog_times = self._prog_times, []
+        return out
 
     @property
     def last_spec_tokens_per_window(self) -> Optional[float]:
@@ -502,7 +514,13 @@ class TutoringEngine:
             chunk = prompts[start : start + cap]
             ids, mask, _ = self.encode_prompts(chunk)
             queued_s = time.monotonic() - t_submit
+            t_gen, t_gen_unix = time.monotonic(), time.time()
             result = self.generate_ids(ids, mask, real_rows=len(chunk))
+            self._prog_times.append(
+                ("generate", t_gen_unix, time.monotonic() - t_gen)
+            )
+            if len(self._prog_times) > self._PROG_TIMES_MAX:
+                del self._prog_times[: -self._PROG_TIMES_MAX]
             # Per-request TTFT counts from batch submission: requests in a
             # later device chunk also waited for every earlier chunk.
             ttfts.extend([queued_s + (self.last_ttft_s or 0.0)] * len(chunk))

@@ -551,6 +551,36 @@ class PagedEngine:
         # Tokens finished requests generated (bench harnesses divide by
         # wall clock for tokens/sec through the serving path).
         self.total_generated_tokens = 0
+        # Flight-recorder observability, drained by the serving queue:
+        # (program, wall-clock start, dispatch seconds) per compiled-
+        # program dispatch — program names key the inventory entries and
+        # the metrics registry's ENGINE_PROGRAM_HISTOGRAMS — and per-rid
+        # pending-queue wait (submit -> popped for admission). Bounded so
+        # a queue-less caller (bench drain loops) cannot grow them.
+        self._prog_times: List[Tuple[str, float, float]] = []
+        self._queue_waits: Dict[int, float] = {}
+
+    _PROG_TIMES_MAX = 4096
+
+    def _time_prog(self, name: str, t0: float, t0_unix: float) -> None:
+        """Record one dispatch's host wall time (device compute overlaps
+        it under pipelining; the dispatch call is what the serving loop
+        actually spends)."""
+        self._prog_times.append((name, t0_unix, time.monotonic() - t0))
+        if len(self._prog_times) > self._PROG_TIMES_MAX:
+            del self._prog_times[: -self._PROG_TIMES_MAX]
+
+    def pop_program_times(self) -> List[Tuple[str, float, float]]:
+        """Drain (program, start_unix, dispatch_s) recorded since last
+        call."""
+        out, self._prog_times = self._prog_times, []
+        return out
+
+    def pop_queue_waits(self) -> Dict[int, float]:
+        """Drain rid -> seconds spent in the pending queue before its
+        prefill was dispatched (the `queue.wait` stage of a trace)."""
+        out, self._queue_waits = self._queue_waits, {}
+        return out
 
     def _init_state(self, width: Optional[int] = None) -> SlotState:
         cache = self.family.init_cache(
@@ -711,6 +741,8 @@ class PagedEngine:
         self._pending = []
         self._inflight = []
         self.ttfts = {}
+        self._prog_times = []
+        self._queue_waits = {}
 
     def _admit(self) -> None:
         # All free slots fill before any host sync: the prefill+install
@@ -737,6 +769,13 @@ class PagedEngine:
             if self._slot_req[slot] is not None or not self._pending:
                 continue
             req = self._pending.pop(0)
+            self._queue_waits[req.rid] = time.monotonic() - req.submit_time
+            if len(self._queue_waits) > self._PROG_TIMES_MAX:
+                # Queue-less callers (bench drain loops, warmup) never
+                # drain: drop the oldest half rather than grow forever.
+                for rid in list(self._queue_waits)[
+                        : -self._PROG_TIMES_MAX // 2]:
+                    self._queue_waits.pop(rid, None)
             # Smallest length bucket that fits: a 10-token query prefills a
             # 16/32-wide program, not the full Tmax-wide one (one compiled
             # prefill per bucket; the decode cache runs at the width the
@@ -754,16 +793,22 @@ class PagedEngine:
                     # Pad the live cache up (donated, in device order after
                     # any in-flight chunks — their snapshots are separate
                     # arrays and unaffected).
+                    t0, t0u = time.monotonic(), time.time()
                     self.state = self._grow(self.state, w_req)
+                    self._time_prog("grow", t0, t0u)
+                t0, t0u = time.monotonic(), time.time()
                 c1, first, seen_row = self._prefill(
                     self.params, jnp.asarray(ids),
                     jnp.asarray(req.prompt_len, jnp.int32), rng,
                 )
+                self._time_prog("prefill", t0, t0u)
+                t0, t0u = time.monotonic(), time.time()
                 self.state = self._install(
                     self.state, jnp.asarray(slot, jnp.int32), c1,
                     jnp.asarray(ids), jnp.asarray(req.prompt_len, jnp.int32),
                     first, seen_row,
                 )
+                self._time_prog("install", t0, t0u)
             admitted.append((slot, req, first))
         if not admitted:
             return
@@ -822,6 +867,7 @@ class PagedEngine:
         if self._live():
             self._rng, rng = jax.random.split(self._rng)
             self.state = self._canon_state(self.state)
+            t0, t0u = time.monotonic(), time.time()
             with self.mesh:
                 if self.spec:
                     self.state, toks, counts, active = self._step(
@@ -832,6 +878,7 @@ class PagedEngine:
                         self.params, self.state, rng
                     )
                     counts = None
+            self._time_prog("step", t0, t0u)
             # No blocking readback here — but START the device->host copies
             # now, so the chunk's results stream back while later chunks
             # compute. On the high-latency bench link this is the entire

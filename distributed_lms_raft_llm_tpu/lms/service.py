@@ -44,6 +44,13 @@ from ..utils.resilience import (
     Deadline,
     request_id_from_grpc_context,
 )
+from ..utils.tracing import (
+    FLAG_DEADLINE,
+    FLAG_DEGRADED,
+    get_tracer,
+    trace_metadata,
+    traced_grpc_handler,
+)
 from .persistence import BlobStore
 from .state import LMSState, hash_password
 
@@ -207,14 +214,21 @@ class LMSServicer(rpc.LMSServicer):
         for clients that opted out of idempotency)."""
         self.metrics.inc("tutoring_degraded")
         log.warning("tutoring degraded (%s); queueing for instructor", reason)
+        # The degraded path is exactly what the flight recorder must never
+        # sample away: flag the trace (pinning it) and record the
+        # instructor-queue write as its own span — the span tree of a
+        # degraded ask still reaches the Raft commit, under the same
+        # request id the client retries with.
         try:
-            await self.node.propose(
-                encode_command(
-                    "AskQuery",
-                    {"username": username, "query": query,
-                     "request_id": request_id or uuid.uuid4().hex},
+            with get_tracer().span("degraded.queue", reason=reason) as dsp:
+                dsp.flag(FLAG_DEGRADED)
+                await self.node.propose(
+                    encode_command(
+                        "AskQuery",
+                        {"username": username, "query": query,
+                         "request_id": request_id or uuid.uuid4().hex},
+                    )
                 )
-            )
         except (NotLeader, TransferInFlight, TimeoutError, RuntimeError) as e:
             # Can't even commit the fallback (lost leadership mid-request):
             # tell the client to retry rather than fake success.
@@ -293,6 +307,7 @@ class LMSServicer(rpc.LMSServicer):
                     resp = await stub.FetchFile(
                         lms_pb2.FetchFileRequest(path=rel_path),
                         timeout=attempt_timeout,
+                        metadata=trace_metadata(),
                     )
                 if resp.found:
                     await loop.run_in_executor(
@@ -310,6 +325,7 @@ class LMSServicer(rpc.LMSServicer):
 
     # ---------------------------------------------------------------- auth
 
+    @traced_grpc_handler("lms.Register")
     async def Register(self, request, context):
         self.metrics.inc("register")
         if not request.username or not request.password:
@@ -355,6 +371,7 @@ class LMSServicer(rpc.LMSServicer):
         )
         return lms_pb2.RegisterResponse(success=won, message=msg)
 
+    @traced_grpc_handler("lms.Login")
     async def Login(self, request, context):
         self.metrics.inc("login")
         if not self.state.check_password(request.username, request.password):
@@ -366,6 +383,7 @@ class LMSServicer(rpc.LMSServicer):
         role = self.state.role_of(request.username) or ""
         return lms_pb2.LoginResponse(success=True, token=token, role=role)
 
+    @traced_grpc_handler("lms.Logout")
     async def Logout(self, request, context):
         if await self._auth_fenced(request.token, context) is None:
             return lms_pb2.LogoutResponse(success=False)
@@ -374,6 +392,7 @@ class LMSServicer(rpc.LMSServicer):
 
     # --------------------------------------------------------------- writes
 
+    @traced_grpc_handler("lms.Post")
     async def Post(self, request, context):
         auth = await self._auth_fenced(request.token, context)
         if auth is None:
@@ -427,6 +446,7 @@ class LMSServicer(rpc.LMSServicer):
 
         return lms_pb2.PostResponse(success=False)
 
+    @traced_grpc_handler("lms.GradeAssignment")
     async def GradeAssignment(self, request, context):
         auth = await self._auth_fenced(request.token, context)
         if auth is None:
@@ -451,6 +471,7 @@ class LMSServicer(rpc.LMSServicer):
         msg = "Grade recorded." if ok else "Grading failed (no leader?)."
         return lms_pb2.GradeResponse(success=ok, message=msg)
 
+    @traced_grpc_handler("lms.RespondToQuery")
     async def RespondToQuery(self, request, grpc_context):
         auth = await self._auth_fenced(request.token, grpc_context)
         if auth is None:
@@ -468,6 +489,7 @@ class LMSServicer(rpc.LMSServicer):
 
     # ---------------------------------------------------------------- reads
 
+    @traced_grpc_handler("lms.Get")
     async def Get(self, request, context):
         await self._read_fence(context)
         auth = self._auth(request.token)
@@ -516,6 +538,7 @@ class LMSServicer(rpc.LMSServicer):
             success=False, message="Invalid request type or unauthorized access"
         )
 
+    @traced_grpc_handler("lms.GetGrade")
     async def GetGrade(self, request, context):
         await self._read_fence(context)
         auth = self._auth(request.token)
@@ -538,6 +561,7 @@ class LMSServicer(rpc.LMSServicer):
                 )
         return lms_pb2.GetGradeResponse(success=True, grade="No grade assigned yet.")
 
+    @traced_grpc_handler("lms.GetUnansweredQueries")
     async def GetUnansweredQueries(self, request, grpc_context):
         await self._read_fence(grpc_context)
         auth = self._auth(request.token)
@@ -549,6 +573,7 @@ class LMSServicer(rpc.LMSServicer):
         ]
         return lms_pb2.GetResponse(success=True, entries=entries)
 
+    @traced_grpc_handler("lms.GetInstructorResponse")
     async def GetInstructorResponse(self, request, grpc_context):
         await self._read_fence(grpc_context)
         auth = self._auth(request.token)
@@ -569,6 +594,7 @@ class LMSServicer(rpc.LMSServicer):
 
     # ------------------------------------------------------------ LLM path
 
+    @traced_grpc_handler("lms.GetLLMAnswer")
     async def GetLLMAnswer(self, request, context):
         await self._read_fence(context)
         self.metrics.inc("llm_requests")
@@ -594,9 +620,14 @@ class LMSServicer(rpc.LMSServicer):
             if self.gate is not None:
                 assignment_text = assignments[0].get("text") or ""
                 loop = asyncio.get_running_loop()
-                passed, sim = await loop.run_in_executor(
-                    None, self.gate.check, request.query, assignment_text
-                )
+                # Span opened on the loop side: run_in_executor does not
+                # propagate contextvars, and the handler's wall view of
+                # the gate (queue + compute) is the budget that matters.
+                with get_tracer().span("gate.check") as gsp:
+                    passed, sim = await loop.run_in_executor(
+                        None, self.gate.check, request.query, assignment_text
+                    )
+                    gsp.set_attr("passed", bool(passed))
                 self.metrics.inc("gate_pass" if passed else "gate_reject")
                 if not passed:
                     return lms_pb2.QueryResponse(
@@ -624,6 +655,9 @@ class LMSServicer(rpc.LMSServicer):
             )
             if deadline is not None and budget <= self._deadline_floor_s:
                 self.metrics.inc("tutoring_budget_exhausted")
+                cur = get_tracer().current()
+                if cur is not None:
+                    cur.flag(FLAG_DEADLINE)
                 return await self._degraded_answer(
                     username, request.query, "deadline budget exhausted",
                     request_id=client_rid,
@@ -651,13 +685,20 @@ class LMSServicer(rpc.LMSServicer):
                     # and the forward's timeout must not overshoot what
                     # the client will actually wait.
                     budget = deadline.timeout(cap=self._tutoring_timeout_s)
-                answer = await stub.GetLLMAnswer(
-                    lms_pb2.QueryRequest(token=fwd_token, query=request.query),
-                    timeout=max(0.001, budget - self._deadline_floor_s)
-                    if deadline is not None else budget,
-                    metadata=(deadline.to_metadata()
-                              if deadline is not None else None),
-                )
+                # trace_metadata called INSIDE the span: the forwarded
+                # x-trace-context carries the forward span's id, so the
+                # tutoring node's fragment grafts under it on the
+                # waterfall.
+                with get_tracer().span("tutoring.forward"):
+                    answer = await stub.GetLLMAnswer(
+                        lms_pb2.QueryRequest(token=fwd_token,
+                                             query=request.query),
+                        timeout=max(0.001, budget - self._deadline_floor_s)
+                        if deadline is not None else budget,
+                        metadata=trace_metadata(
+                            deadline.to_metadata()
+                            if deadline is not None else None),
+                    )
                 if plan is not None and plan.duplicate:
                     # Deliver the query twice, like FaultyTransport does
                     # for Raft RPCs: the hop is a pure read/compute, so a
@@ -673,15 +714,19 @@ class LMSServicer(rpc.LMSServicer):
                     if deadline is not None:
                         budget = deadline.timeout(cap=self._tutoring_timeout_s)
                     try:
-                        answer = await stub.GetLLMAnswer(
-                            lms_pb2.QueryRequest(
-                                token=fwd_token, query=request.query
-                            ),
-                            timeout=max(0.001, budget - self._deadline_floor_s)
-                            if deadline is not None else budget,
-                            metadata=(deadline.to_metadata()
-                                      if deadline is not None else None),
-                        )
+                        with get_tracer().span("tutoring.forward",
+                                               duplicate=True):
+                            answer = await stub.GetLLMAnswer(
+                                lms_pb2.QueryRequest(
+                                    token=fwd_token, query=request.query
+                                ),
+                                timeout=max(0.001,
+                                            budget - self._deadline_floor_s)
+                                if deadline is not None else budget,
+                                metadata=trace_metadata(
+                                    deadline.to_metadata()
+                                    if deadline is not None else None),
+                            )
                     except grpc.RpcError as e:
                         log.info("duplicate delivery failed (%s); keeping "
                                  "the first answer", e.code())
@@ -700,6 +745,7 @@ class LMSServicer(rpc.LMSServicer):
             self.tutoring_breaker.record_success()
         return answer
 
+    @traced_grpc_handler("lms.WhoIsLeader")
     async def WhoIsLeader(self, request, context):
         # Implemented on LMS as the contract declares (reference D6 left it
         # UNIMPLEMENTED and clients had to use the RaftService one).
@@ -713,6 +759,7 @@ class FileTransferServicer(rpc.FileTransferServiceServicer):
     def __init__(self, blobs: BlobStore):
         self.blobs = blobs
 
+    @traced_grpc_handler("file.SendFile")
     async def SendFile(self, request_iterator, context):
         writer = None
         try:
@@ -730,6 +777,7 @@ class FileTransferServicer(rpc.FileTransferServiceServicer):
             log.warning("SendFile failed: %s", e)
             return lms_pb2.FileTransferResponse(status=f"error: {e}")
 
+    @traced_grpc_handler("file.FetchFile")
     async def FetchFile(self, request, context):
         """Pull path for blob anti-entropy (see LMSServicer._blob)."""
         loop = asyncio.get_running_loop()
@@ -744,6 +792,7 @@ class FileTransferServicer(rpc.FileTransferServiceServicer):
             return lms_pb2.FetchFileResponse(found=False)
         return lms_pb2.FetchFileResponse(found=True, content=content)
 
+    @traced_grpc_handler("file.ReplicateData")
     async def ReplicateData(self, request, context):
         """Direct blob push (metadata rides Raft; this is the bulk path)."""
         try:
@@ -809,7 +858,8 @@ async def replicate_file_to_peers(
                             destination_path=rel_path,
                         )
 
-                resp = await stub.SendFile(chunks(), timeout=attempt_timeout)
+                resp = await stub.SendFile(chunks(), timeout=attempt_timeout,
+                                           metadata=trace_metadata())
                 results[peer] = resp.status
         except grpc.RpcError as e:
             results[peer] = f"error: {e.code()}"

@@ -24,6 +24,7 @@ import logging
 import time
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
+from ..utils.tracing import get_tracer
 from .core import NotLeader, RaftConfig, RaftCore, Role
 from .messages import (
     NOOP,
@@ -139,9 +140,16 @@ class RaftNode:
         await self.transport.close()
 
     async def propose(self, command: str, timeout: float = 10.0) -> int:
-        """Replicate `command`; resolves with its index once COMMITTED."""
-        index = self.core.propose(command, time.monotonic())
-        return await self._await_commit(index, timeout)
+        """Replicate `command`; resolves with its index once COMMITTED.
+
+        Under an active request trace this is the `raft.commit` span: the
+        whole propose→append→quorum→apply path, ending when the commit
+        waiter resolves (i.e. the entry has been applied locally). A no-op
+        span outside any trace, so Raft-internal proposes cost nothing."""
+        with get_tracer().span("raft.commit") as sp:
+            index = self.core.propose(command, time.monotonic())
+            sp.set_attr("index", index)
+            return await self._await_commit(index, timeout)
 
     async def propose_config(
         self, members: Dict[int, str], timeout: float = 10.0

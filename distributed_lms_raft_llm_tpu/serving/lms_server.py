@@ -37,8 +37,10 @@ from ..raft import RaftConfig
 from ..raft.grpc_transport import RaftServicer
 from ..utils.diskfaults import DiskFaultInjector
 from ..utils.faults import CampaignRunner, FaultInjector
+from ..utils.guards import make_serving_watchdog
 from ..utils.metrics import Metrics
 from ..utils.resilience import CircuitBreaker
+from ..utils.tracing import trace_admin_get
 
 log = logging.getLogger("lms_server")
 
@@ -158,7 +160,12 @@ def make_admin(lms_node: LMSNode, faults: FaultInjector,
         """GET /admin/faults — read-only introspection of the active
         fault/campaign configuration. The plane used to be write-only:
         an operator (or the semester sim's auditor) could INSTALL chaos
-        but never assert what was currently injected."""
+        but never assert what was currently injected.
+        GET /admin/trace — the flight recorder's pinned exemplars plus
+        recent traces; GET /admin/trace/<request-id> — the assembled span
+        forest for one request (utils/tracing.py)."""
+        if path.startswith("/admin/trace"):
+            return trace_admin_get(path)
         if path != "/admin/faults":
             raise KeyError(path)
         return fault_state(faults, disk_faults, campaigns)
@@ -317,10 +324,18 @@ async def serve_async(args) -> None:
             log.info("metrics %s", json.dumps(metrics.snapshot()))
 
     reporter = asyncio.get_running_loop().create_task(report())
+    # Serving-loop heartbeat: a handler that blocks this loop (sync IO, a
+    # long pure-Python stretch) surfaces as serving_tick_lag/-_stalls in
+    # /metrics instead of being inferred from p99 tails. Distinct from the
+    # Raft tick watchdog: this loop also owns every gRPC handler.
+    watchdog = asyncio.get_running_loop().create_task(
+        make_serving_watchdog(metrics).run()
+    )
     try:
         await server.wait_for_termination()
     finally:
         reporter.cancel()
+        watchdog.cancel()
         campaigns.cancel()
         if health is not None:
             await health.stop()
@@ -460,6 +475,11 @@ def main(argv=None) -> None:
             # Negative flag can't carry the file value through the
             # sentinel probe; mirror the linearizable_reads merge.
             args.storage_checksums = cfg.storage.checksums
+        # [tracing]: rebuild the process tracer (ring size, exemplar pins,
+        # kill switch) before the first request can open a span.
+        from ..utils.tracing import configure_from
+
+        configure_from(cfg.tracing)
     elif args.id is None or args.port is None or not args.peers:
         parser.error("need either positional <id> <port> <peers...> or "
                      "--config <file> --id <node id>")

@@ -36,8 +36,10 @@ from ..engine import (
 )
 from ..proto import lms_pb2, rpc
 from ..utils import auth
+from ..utils.guards import make_serving_watchdog
 from ..utils.metrics import Metrics
 from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
+from ..utils.tracing import get_tracer, trace_admin_get, traced_grpc_handler
 
 log = logging.getLogger("tutoring_server")
 
@@ -56,6 +58,7 @@ class TutoringService(rpc.TutoringServicer):
         self.metrics = metrics
         self.auth_key = auth_key
 
+    @traced_grpc_handler("tutoring.GetLLMAnswer")
     async def GetLLMAnswer(self, request, context):
         self.metrics.inc("llm_requests")
         if self.auth_key and not auth.verify_query(
@@ -86,7 +89,13 @@ class TutoringService(rpc.TutoringServicer):
             # Full-answer latency for this RPC; the "ttft" histogram is fed
             # by the batcher from the engine's measured first-token time.
             with self.metrics.time("answer_latency"):
-                answer = await self.queue.submit(prompt, deadline=deadline)
+                # The handler's trace fragment rides into the batcher as an
+                # explicit span handle: queue internals run on other tasks
+                # (and the engine in an executor thread), where contextvars
+                # from this handler are not in scope.
+                answer = await self.queue.submit(
+                    prompt, deadline=deadline, span=get_tracer().current()
+                )
         except Overloaded as e:
             # The wire's backpressure signal: clients back off and retry,
             # the LMS breaker counts it toward opening.
@@ -148,15 +157,28 @@ async def serve_async(
     server._port = server.add_insecure_port(f"[::]:{port}")
     await server.start()
     # Keep strong references (asyncio tasks are weakly held by the loop) and
-    # expose them for shutdown: callers should cancel _metrics_task and await
+    # expose them for shutdown: callers should cancel _metrics_task /
+    # _watchdog_task and await
     # _queue.close() after stop().
     server._metrics_task = asyncio.get_running_loop().create_task(
         _report_metrics(metrics, metrics_period_s)
+    )
+    # Heartbeat watchdog on the serving loop: an engine call that
+    # accidentally blocks the loop (instead of running in the executor)
+    # shows up as serving_tick_lag/serving_tick_stalls in /metrics.
+    server._watchdog_task = asyncio.get_running_loop().create_task(
+        make_serving_watchdog(metrics).run()
     )
     server._queue = queue
     server._health = None
     if metrics_port is not None:
         from ..utils.healthz import HealthServer
+
+        async def admin_get(path: str) -> dict:
+            # GET /admin/trace[/id]: this node's flight-recorder fragments
+            # (engine spans live HERE; trace_report merges them with the
+            # LMS nodes' fragments into one waterfall).
+            return trace_admin_get(path)
 
         server._health = HealthServer(
             metrics,
@@ -170,6 +192,7 @@ async def serve_async(
                 "queue_depth_limit": max_queue,
                 "queued": queue.waiting,
             },
+            admin_get=admin_get,
             port=metrics_port,
         )
         bound = await server._health.start()
@@ -282,6 +305,11 @@ def main(argv=None) -> None:
             temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
             repetition_penalty=s.repetition_penalty,
         )
+        # Rebuild the process tracer from [tracing] (ring size, exemplar
+        # pins, kill switch) before any request can open a span.
+        from ..utils.tracing import configure_from
+
+        configure_from(cfg.tracing)
     else:
         args.sampling_overrides = {}
     if args.jax_platform == "cpu":

@@ -29,7 +29,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import grpc
 
@@ -43,6 +43,7 @@ from ..serving.lms_server import make_admin, make_health
 from ..serving.tutoring_server import TutoringService
 from ..utils.diskfaults import DiskFaultInjector
 from ..utils.faults import CampaignRunner, FaultInjector
+from ..utils.guards import make_serving_watchdog
 from ..utils.healthz import HealthServer
 from ..utils.metrics import Metrics
 from ..utils.resilience import CircuitBreaker
@@ -72,16 +73,29 @@ class EchoEngine:
 
     A tiny sleep gives the latency histograms a real (but bounded)
     distribution; it runs in the batcher's executor, never on the loop.
+    Speaks the real engines' `pop_program_times` contract too, so sim
+    traces carry an `engine.generate` program span and the
+    `engine_prog_generate` histogram fills — the SAME reap path the
+    TutoringEngine exercises, not a sim-only shortcut.
     """
 
     def __init__(self, delay_s: float = 0.002):
         self.delay_s = delay_s
+        self._prog_times: List[Tuple[str, float, float]] = []
 
     def answer_batch(self, prompts: List[str]) -> List[str]:
+        t0, t0_unix = time.monotonic(), time.time()
         time.sleep(self.delay_s)
+        self._prog_times.append(
+            ("generate", t0_unix, time.monotonic() - t0)
+        )
         return [f"Echo tutor: {p.splitlines()[-2][:96]}"
                 if len(p.splitlines()) >= 2 else f"Echo tutor: {p[:96]}"
                 for p in prompts]
+
+    def pop_program_times(self) -> List[Tuple[str, float, float]]:
+        out, self._prog_times = self._prog_times, []
+        return out
 
 
 class KeywordGate:
@@ -421,12 +435,17 @@ class SimCluster:
             port=self._health_ports[nid],
         )
         await health.start()
+        # Same serving-loop heartbeat the production entrypoint runs, so
+        # the sim's SLO scrape sees serving_tick_lag/-_stalls per node.
+        watchdog = asyncio.get_running_loop().create_task(
+            make_serving_watchdog(metrics).run()
+        )
         with self._lock:
             self._nodes[nid] = {
                 "lms_node": lms_node, "server": server, "health": health,
                 "faults": faults, "disk_faults": disk_faults,
                 "campaigns": campaigns, "metrics": metrics,
-                "breaker": breaker,
+                "breaker": breaker, "watchdog": watchdog,
             }
 
     async def _stop_node(self, nid: int) -> None:
@@ -435,6 +454,7 @@ class SimCluster:
         if rec is None:
             return
         rec["campaigns"].cancel()
+        rec["watchdog"].cancel()
         await rec["health"].stop()
         await rec["lms_node"].stop()
         await rec["server"].stop(None)

@@ -39,6 +39,7 @@ from ..utils import metrics_registry as metric
 from ..utils import pdf
 from ..utils.metrics import Metrics
 from ..utils.resilience import DeadlineExpired
+from ..utils.tracing import get_tracer
 from . import events as ev
 from . import workload as wl
 from .cluster import SimCluster
@@ -94,6 +95,11 @@ class SemesterSim:
         t_start = time.monotonic()
         ops = self.gen.ops()
         plan = ev.plan_events(self.cfg)
+        # Fresh flight recorder per run: the process-global tracer may
+        # hold a previous run's traces (back-to-back sims in one test
+        # process), which would pollute the per-stage p95s and could pin
+        # a stale trace as this run's slowest exemplar.
+        get_tracer().reset()
         try:
             # Inside the try: a partial boot (no leader within the
             # timeout, a stolen port) must still tear the cluster down,
@@ -116,14 +122,16 @@ class SemesterSim:
             self._settle()
             self._audit()
             node_metrics, node_health = self.cluster.scrape_all()
+            traces = get_tracer().records()
             report = evaluate_slos(
                 self.cfg, node_metrics, node_health,
                 self.metrics.snapshot(), self.ledger.report(),
                 event_failures=scheduler.failures(),
+                traces=traces,
                 metrics=self.metrics,
             )
             return self._record(ops, plan, scheduler, report, node_metrics,
-                                time.monotonic() - t_start)
+                                traces, time.monotonic() - t_start)
         finally:
             for c in self._clients.values():
                 c.close()
@@ -480,7 +488,7 @@ class SemesterSim:
     # ---------------------------------------------------------------- record
 
     def _record(self, ops, plan, scheduler, report, node_metrics,
-                wall_s: float) -> Dict:
+                traces, wall_s: float) -> Dict:
         snap = self.metrics.snapshot()
         counters = snap.get("counters", {})
         ask = snap.get("latency", {}).get("sim_ask_latency", {})
@@ -491,6 +499,21 @@ class SemesterSim:
             # counters reset) — good enough for ">= 1 really happened".
             return sum(int(s.get("counters", {}).get(name, 0))
                        for s in node_metrics.values())
+
+        # The flight recorder's verdict attachments: exemplar digests
+        # (what was pinned and why — slow, degraded, errored) and the
+        # slowest ask's FULL span tree, so a perf regression's BENCH line
+        # carries its own waterfall (`scripts/trace_report.py --json`)
+        # instead of sending the reader off to rerun the sim.
+        exemplars = [
+            {"trace_id": t["trace_id"], "route": t["route"],
+             "duration_s": t["duration_s"], "flags": t["flags"]}
+            for t in sorted(traces, key=lambda t: -t["duration_s"])
+            if t.get("flags")
+            or t["route"].startswith("client.ask_llm")
+        ][:8]
+        asks = [t for t in traces if t["route"] == "client.ask_llm"]
+        slowest = max(asks, key=lambda t: t["duration_s"], default=None)
         return {
             # BENCH schema: one headline metric + the full story around it.
             "metric": "semester_sim_ask_p95_s",
@@ -514,6 +537,8 @@ class SemesterSim:
             "events": scheduler.outcomes,
             "events_executed": scheduler.executed_kinds(),
             "slos": report.to_dict(),
+            "trace_exemplars": exemplars,
+            "slowest_trace": slowest,
             "wall_s": round(wall_s, 1),
         }
 

@@ -19,12 +19,19 @@ Checks:
   scheduler): the acceptance criteria — >=1 transfer, >=1 quarantine,
   >=1 membership change — are part of the verdict, not just the CLI's
   exit code.
+
+The verdict also carries **per-stage p95 breakdowns** computed from the
+flight recorder's retained traces (utils/tracing.py): the aggregate
+`answer_p95` bound says *whether* the cluster met its budget, the stage
+breakdown says *where* the budget went (raft commit vs gate vs queue
+wait vs engine programs) — so an SLO failure arrives self-explaining
+instead of starting the next perf investigation from guesswork.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Sequence
+from typing import Any, Dict, List, Sequence
 
 from ..config import SimConfig
 from ..utils import metrics_registry as metric
@@ -41,6 +48,11 @@ class SloCheck:
 @dataclasses.dataclass
 class SloReport:
     checks: List[SloCheck]
+    # Span name -> {count, p50_s, p95_s, max_s}: where the answer budget
+    # actually went, computed from retained traces (stage_breakdown).
+    stage_p95s: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def ok(self) -> bool:
@@ -55,7 +67,41 @@ class SloReport:
             "checks": {c.name: {"ok": c.ok, "observed": c.observed,
                                 "bound": c.bound}
                        for c in self.checks},
+            "stage_p95s": self.stage_p95s,
         }
+
+
+def _walk_spans(span: Dict[str, Any], out: Dict[str, List[float]]) -> None:
+    out.setdefault(span["name"], []).append(float(span.get("duration_s",
+                                                           0.0)))
+    for child in span.get("children", ()):
+        _walk_spans(child, out)
+
+
+def stage_breakdown(
+    traces: Sequence[Dict[str, Any]],
+) -> Dict[str, Dict[str, float]]:
+    """Per-stage latency stats from assembled trace dicts
+    (`Tracer.records()` / `GET /admin/trace/<id>` shape): span name ->
+    {count, p50_s, p95_s, max_s}. Spans aggregate by NAME — `queue.wait`
+    collects every request's queue wait regardless of which node recorded
+    it — so the result reads as attributable per-stage budgets next to
+    the aggregate `answer_p95` SLO bound."""
+    by_name: Dict[str, List[float]] = {}
+    for trace in traces:
+        for root in trace.get("spans", ()):
+            _walk_spans(root, by_name)
+    out: Dict[str, Dict[str, float]] = {}
+    for name, durs in sorted(by_name.items()):
+        durs.sort()
+        n = len(durs)
+        out[name] = {
+            "count": n,
+            "p50_s": round(durs[n // 2], 6),
+            "p95_s": round(durs[min(int(n * 0.95), n - 1)], 6),
+            "max_s": round(durs[-1], 6),
+        }
+    return out
 
 
 def _counter(snap: Dict, name: str) -> int:
@@ -74,12 +120,14 @@ def evaluate_slos(
     ledger_report: Dict,
     *,
     event_failures: Sequence[Dict] = (),
+    traces: Sequence[Dict[str, Any]] = (),
     metrics=None,
 ) -> SloReport:
     """`node_metrics`/`node_health`: node id -> scraped JSON snapshots of
     every node alive at the end of the run; `sim_metrics`: the harness's
     own Metrics snapshot; `ledger_report`: `WriteLedger.report()`;
-    `event_failures`: the scheduler's `ok=False` outcomes."""
+    `event_failures`: the scheduler's `ok=False` outcomes; `traces`: the
+    flight recorder's retained trace trees (per-stage breakdowns)."""
     checks: List[SloCheck] = []
 
     def check(name: str, ok: bool, observed: str, bound: str) -> None:
@@ -151,4 +199,4 @@ def evaluate_slos(
           f"{len(failed)} failed" + (f": {failed[:3]}" if failed else ""),
           "every planned event ok")
 
-    return SloReport(checks=checks)
+    return SloReport(checks=checks, stage_p95s=stage_breakdown(traces))

@@ -345,3 +345,23 @@ def make_tick_watchdog(
         lag_metric=metrics_registry.RAFT_TICK_LAG if default else None,
         stalls_metric=metrics_registry.RAFT_TICK_STALLS if default else None,
     )
+
+
+def make_serving_watchdog(
+    metrics: Any, *, warn_above_s: float = 0.25,
+) -> LoopWatchdog:
+    """`make_tick_watchdog` generalized to the gRPC serving event loop:
+    the server entry points run `watchdog.run(interval)` as a standalone
+    heartbeat task, so a handler that blocks the loop (sync IO, a device
+    readback, a long pure-Python stretch) shows up as the
+    `serving_tick_lag` histogram and `serving_tick_stalls` counter in
+    /metrics instead of being inferred from p99 latency tails. Every
+    server entrypoint owns a Metrics instance, so `metrics` is required —
+    callers chain `.run()` directly."""
+    from . import metrics_registry
+
+    return LoopWatchdog(
+        metrics, name="serving_tick", warn_above_s=warn_above_s,
+        lag_metric=metrics_registry.SERVING_TICK_LAG,
+        stalls_metric=metrics_registry.SERVING_TICK_STALLS,
+    )

@@ -47,9 +47,13 @@ class LatencyHistogram:
         with self._lock:
             n = len(self._samples)
             if n == 0:
-                return {"count": 0}
+                return {"count": 0, "samples": 0}
             return {
                 "count": self._count,
+                # Reservoir size the percentiles below are computed from
+                # (== count until the reservoir wraps at max_samples):
+                # readers can judge how trustworthy a p95/p99 is.
+                "samples": n,
                 "mean_s": self._total / self._count,
                 "p50_s": self._samples[n // 2],
                 "p90_s": self._samples[min(int(n * 0.9), n - 1)],

@@ -219,6 +219,50 @@ SPEC_ACCEPTED_TOKENS = counter(
     "window",
 )
 
+# Per-program engine dispatch wall time (host-side: the time the serving
+# loop spends issuing each compiled program; device compute overlaps it
+# under pipelining). Names key the program-inventory entries — the
+# serving queues map the engine's reported program name through
+# ENGINE_PROGRAM_HISTOGRAMS below, and the same measurements become
+# `engine.<program>` spans on the request trace.
+
+ENGINE_PROG_PREFILL = histogram(
+    "engine_prog_prefill",
+    "paged-engine _prefill program dispatch wall time (one fresh-slot "
+    "prompt pass)",
+)
+ENGINE_PROG_INSTALL = histogram(
+    "engine_prog_install",
+    "paged-engine _install program dispatch wall time (splicing a "
+    "prefilled slot into the live state)",
+)
+ENGINE_PROG_STEP = histogram(
+    "engine_prog_step",
+    "paged-engine _step/_spec_step program dispatch wall time (one "
+    "chunk of decode scan iterations)",
+)
+ENGINE_PROG_GROW = histogram(
+    "engine_prog_grow",
+    "paged-engine _grow program dispatch wall time (cache width "
+    "transition)",
+)
+ENGINE_PROG_GENERATE = histogram(
+    "engine_prog_generate",
+    "bucketed-engine generate dispatch wall time (one grouped device "
+    "batch, prefill through last token)",
+)
+
+# Engine-reported program name -> declared histogram, used by the serving
+# queues (engine/batcher.py). Living HERE keeps the mapping inside the
+# declared namespace (see BREAKER_TRANSITION_COUNTERS).
+ENGINE_PROGRAM_HISTOGRAMS: Dict[str, str] = {
+    "prefill": ENGINE_PROG_PREFILL,
+    "install": ENGINE_PROG_INSTALL,
+    "step": ENGINE_PROG_STEP,
+    "grow": ENGINE_PROG_GROW,
+    "generate": ENGINE_PROG_GENERATE,
+}
+
 # Storage layer (raft/storage.py + lms/persistence.py via lms/node.py).
 
 WAL_TORN_TAIL_TRUNCATIONS = counter(
@@ -313,6 +357,21 @@ RAFT_TICK_LAG = histogram(
 RAFT_TICK_STALLS = counter(
     "raft_tick_stalls",
     "Raft ticks later than 10 heartbeat intervals (each also logged)",
+)
+
+# Serving event loop (utils/guards.py LoopWatchdog heartbeat wired by the
+# gRPC server entry points): handler stalls become visible series instead
+# of being inferred from latency tails.
+
+SERVING_TICK_LAG = histogram(
+    "serving_tick_lag",
+    "how late the serving event loop's heartbeat ran versus its schedule "
+    "(a stall here means a handler blocked the loop)",
+)
+SERVING_TICK_STALLS = counter(
+    "serving_tick_stalls",
+    "serving-loop heartbeats later than the stall threshold (each also "
+    "logged)",
 )
 
 

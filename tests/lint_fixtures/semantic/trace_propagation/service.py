@@ -1,0 +1,76 @@
+"""trace-propagation fixtures: handlers, helpers, callbacks, egress."""
+
+import asyncio
+
+from tracing import trace_metadata  # noqa: F401 - fixture-local stand-in
+
+
+class FooServicer(rpc.FooServicer):  # noqa: F821 - fixture, never imported
+    async def NoMetadata(self, request, context):
+        stub = self._stub()
+        return await stub.FetchThing(request, timeout=t)  # EXPECT: trace-propagation
+
+    async def BareMetadata(self, request, context):
+        # Metadata built without the wrapper: the x-trace-context chain
+        # breaks even though SOME metadata flows.
+        return await self.stub.SendThing(  # EXPECT: trace-propagation
+            request, metadata=deadline.to_metadata()  # noqa: F821
+        )
+
+    async def HelperPath(self, request, context):
+        return await self._forward(request)
+
+    async def _forward(self, request):
+        # Reachable through the handler's call, one hop deep.
+        return await self.stub.SendThing(request)  # EXPECT: trace-propagation
+
+    async def GoodWrapped(self, request, context):
+        # The fix shape: existing metadata wrapped, never flagged.
+        return await self.stub.FetchThing(
+            request, metadata=trace_metadata(deadline.to_metadata())  # noqa: F821
+        )
+
+    async def GoodWrappedNone(self, request, context):
+        return await self.stub.FetchThing(request,
+                                          metadata=trace_metadata())
+
+    async def GoodModuleQualified(self, request, context):
+        return await self.stub.FetchThing(
+            request, metadata=tracing.trace_metadata()  # noqa: F821
+        )
+
+    async def ConstructorsAreNotEgress(self, request, context):
+        # CamelCase but never awaited: protobuf request constructors.
+        req = pb2.FetchThingRequest(path="x")  # noqa: F821
+        return await self.stub.FetchThing(req, metadata=trace_metadata())
+
+    async def SnakeCaseHelpersAreNotEgress(self, request, context):
+        # asyncio.wait_for is not a gRPC stub call (snake_case).
+        return await asyncio.wait_for(self.queue.get(), timeout=5)
+
+    async def Sanctioned(self, request, context):
+        # A deliberately untraced probe, visibly suppressed.
+        return await self.stub.Probe(request)  # lint: disable=trace-propagation
+
+
+class Node:
+    def __init__(self, raft):
+        # Address-taken: the callback runs on the serving loop in response
+        # to committed RPCs, so everything it calls is handler-reachable.
+        raft.apply_cb = self._apply
+
+    def _apply(self, index, entry):
+        asyncio.ensure_future(replicate_to_peers(self.addresses, entry))
+
+
+async def replicate_to_peers(addresses, entry):
+    for addr in addresses:
+        async with channel(addr) as ch:  # noqa: F821
+            stub = make_stub(ch)  # noqa: F821
+            await stub.SendFile(entry, timeout=t)  # EXPECT: trace-propagation
+
+
+async def unreferenced_helper(stub, request):
+    # Dead code: no handler reaches it, no reference escapes — this
+    # rule's reachability requirement keeps it out of scope.
+    return await stub.SendAll(request)

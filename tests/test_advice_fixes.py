@@ -31,7 +31,7 @@ from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
 
 
 class _EchoQueue:
-    async def submit(self, prompt: str, deadline=None) -> str:
+    async def submit(self, prompt: str, deadline=None, span=None) -> str:
         return "the tutor's answer"
 
 

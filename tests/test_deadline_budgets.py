@@ -76,7 +76,7 @@ def test_blob_fetch_timeout_derived_from_live_budget(tmp_path, monkeypatch):
         def __init__(self, channel):
             pass
 
-        async def FetchFile(self, request, timeout=None):
+        async def FetchFile(self, request, timeout=None, metadata=None):
             captured.append(timeout)
             return types.SimpleNamespace(found=False, content=b"")
 
@@ -174,7 +174,7 @@ def test_blob_fetch_floor_behavior(tmp_path, monkeypatch, budget_s, cap,
         def __init__(self, channel):
             pass
 
-        async def FetchFile(self, request, timeout=None):
+        async def FetchFile(self, request, timeout=None, metadata=None):
             dialed.append(timeout)
             return types.SimpleNamespace(found=False, content=b"")
 

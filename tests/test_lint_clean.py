@@ -24,6 +24,9 @@ from distributed_lms_raft_llm_tpu.analysis.rules.deadline_flow import (
 from distributed_lms_raft_llm_tpu.analysis.rules.metrics_registry import (
     MetricsRegistryRule,
 )
+from distributed_lms_raft_llm_tpu.analysis.rules.trace_propagation import (
+    TracePropagationRule,
+)
 from distributed_lms_raft_llm_tpu.utils import metrics_registry
 
 REPO = Path(__file__).resolve().parent.parent
@@ -104,13 +107,51 @@ def test_reverting_blob_fetch_timeout_fix_fails_lint():
 
 def test_reverting_replicate_timeout_fix_fails_lint():
     project = _project_with_patched_service(
-        "timeout=attempt_timeout)", "timeout=30)"
+        "SendFile(chunks(), timeout=attempt_timeout,",
+        "SendFile(chunks(), timeout=30,",
     )
     findings = [
         f for f in DeadlineFlowRule().check_project(project)
         if f.path == SERVICE
     ]
     assert findings, "a re-hardcoded SendFile timeout must fail deadline-flow"
+
+
+def test_metadata_dropping_egress_fails_lint():
+    """PR 8 acceptance pin: strip trace_metadata() off the blob-fetch
+    egress (what reverting the instrumentation sweep would do) and the
+    x-trace-context chain breaks — trace-propagation must catch it."""
+    project = _project_with_patched_service(
+        "metadata=trace_metadata(),", ""
+    )
+    findings = [
+        f for f in TracePropagationRule().check_project(project)
+        if f.path == SERVICE and "FetchFile" in f.message
+    ]
+    assert findings, (
+        "an egress that drops the trace metadata must fail trace-propagation"
+    )
+
+
+def test_bare_metadata_egress_fails_lint():
+    """The subtler break: metadata still flows (the deadline budget), but
+    without the wrapper the trace context is silently dropped."""
+    project = _project_with_patched_service(
+        "metadata=trace_metadata(\n"
+        "                            deadline.to_metadata()\n"
+        "                            if deadline is not None else None),",
+        "metadata=(\n"
+        "                            deadline.to_metadata()\n"
+        "                            if deadline is not None else None),",
+    )
+    findings = [
+        f for f in TracePropagationRule().check_project(project)
+        if f.path == SERVICE and "GetLLMAnswer" in f.message
+    ]
+    assert findings, (
+        "an egress whose metadata bypasses trace_metadata() must fail "
+        "trace-propagation"
+    )
 
 
 def test_unregistered_metric_name_fails_lint():

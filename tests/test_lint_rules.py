@@ -67,6 +67,9 @@ from distributed_lms_raft_llm_tpu.analysis.rules.slow_marker import (
     SlowMarkerRule,
     audit,
 )
+from distributed_lms_raft_llm_tpu.analysis.rules.trace_propagation import (
+    TracePropagationRule,
+)
 from distributed_lms_raft_llm_tpu.analysis.rules.tracer_hygiene import (
     TracerHygieneRule,
 )
@@ -206,6 +209,14 @@ def test_config_consistency_fixture():
 
 def test_guarded_by_flow_fixture():
     run_project_rule(GuardedByFlowRule(), "guarded_by_flow")
+
+
+def test_trace_propagation_fixture():
+    # Same widening as deadline-flow: the real default scopes to the
+    # lms/ + serving/ request-path modules.
+    run_project_rule(
+        TracePropagationRule(watch_prefixes=("",)), "trace_propagation"
+    )
 
 
 # ------------------------------------------- abstract interpretation
