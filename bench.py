@@ -135,13 +135,22 @@ def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False,
 
 def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
                 batch: int = BATCH, spec_tokens: int = 0,
-                greedy: bool = False, chunk: int = 16) -> dict:
+                greedy: bool = False, chunk: int = 16, megastep: int = 1,
+                megastep_max: int = 0, inflight: int = 2,
+                max_new: int = MAX_NEW, rounds: int = ROUNDS,
+                prompt_len: int = PROMPT_LEN,
+                length_buckets=None) -> dict:
     """Continuous-batching throughput/TTFT through PagedEngine directly.
 
     Same shape of numbers as bench_tpu so paged and paged+spec enter the
     recorded perf trajectory: sustained tokens/sec/chip with `batch` busy
-    slots (ROUNDS x batch requests churning through), then idle-engine
-    batch-1 TTFT medians. Spec acceptance rides along when spec_tokens>0.
+    slots (rounds x batch requests churning through), then idle-engine
+    batch-1 TTFT medians. Spec acceptance rides along when spec_tokens>0;
+    megastep knobs and the measured host-dispatches-per-token ratio ride
+    along always (the device-resident megastep's target number). The
+    workload knobs (max_new/rounds/prompt_len/length_buckets) default to
+    the recorded configuration; the tier-1 CPU smoke test shrinks them so
+    the record path cannot rot between chip attachments.
     """
     import jax
 
@@ -150,18 +159,21 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
         PagedEngine,
         SamplingParams,
     )
+    from distributed_lms_raft_llm_tpu.engine.program_inventory import (
+        effective_megastep_max,
+    )
 
     n_chips = max(1, len(jax.devices()))
     artifacts = ensure_local_artifacts() if model == "gpt2" else {}
     sampling = (
-        SamplingParams.greedy(max_new_tokens=MAX_NEW) if greedy
-        else SamplingParams.reference_defaults(max_new_tokens=MAX_NEW)
+        SamplingParams.greedy(max_new_tokens=max_new) if greedy
+        else SamplingParams.reference_defaults(max_new_tokens=max_new)
     )
     engine = PagedEngine(
         EngineConfig(
             model=model,
             sampling=sampling,
-            length_buckets=(PROMPT_LEN, 64, 128),
+            length_buckets=tuple(length_buckets or (prompt_len, 64, 128)),
             batch_buckets=tuple(sorted({1, 2, 4, 8, batch})),
             tp=tp,
             quant="int8" if quant else None,
@@ -171,17 +183,21 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
         ),
         slots=batch,
         chunk=chunk,
+        inflight=inflight,
+        megastep=megastep,
+        megastep_max=megastep_max,
     )
     rng = np.random.default_rng(0)
     prompts = [
         engine.tokenizer.decode(
-            rng.integers(0, engine.tokenizer.vocab_size, PROMPT_LEN).tolist()
+            rng.integers(0, engine.tokenizer.vocab_size, prompt_len).tolist()
         )
-        for _ in range(ROUNDS * batch)
+        for _ in range(rounds * batch)
     ]
     compile_s = engine.warmup()
 
     engine.pop_spec_stats()
+    engine.pop_dispatch_stats()
     engine.total_generated_tokens = 0
     t0 = time.monotonic()
     for p in prompts:
@@ -190,6 +206,7 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
     elapsed = time.monotonic() - t0
     tps = engine.total_generated_tokens / elapsed
     spec_stats = engine.pop_spec_stats()
+    dispatches, emitted, dead_lanes = engine.pop_dispatch_stats()
     engine.pop_ttfts()
 
     # Idle-engine TTFT (same protocol as bench_tpu: median of 7 batch-1
@@ -207,12 +224,20 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
         "ttft_p50_ms": ttft_ms,
         "compile_s": compile_s,
         "batch": batch,
+        "chunk": chunk,
+        "megastep": megastep,
+        "megastep_max": effective_megastep_max(megastep, megastep_max),
+        "inflight": inflight,
+        "host_dispatches_per_token": (
+            dispatches / emitted if emitted else None
+        ),
+        "megastep_dead_lane_tokens": dead_lanes,
         "platform": jax.devices()[0].platform,
     }
     if spec_stats is not None:
-        windows, emitted = spec_stats
+        windows, spec_emitted = spec_stats
         out["spec_tokens_per_window"] = (
-            emitted / windows if windows else None
+            spec_emitted / windows if windows else None
         )
     return out
 
@@ -284,8 +309,16 @@ def main() -> None:
                          "of the group-batched engine (composes with "
                          "--spec-tokens: per-slot verify windows)")
     ap.add_argument("--chunk", type=int, default=16,
-                    help="paged: tokens (spec: verify windows) per "
-                         "dispatched step program")
+                    help="paged: tokens (spec: verify windows) per device "
+                         "chunk (one step program; a megastep fuses K)")
+    ap.add_argument("--megastep", type=int, default=1,
+                    help="paged: starting K of the megastep controller — "
+                         "chunks fused per host dispatch (1 = chunk loop)")
+    ap.add_argument("--megastep-max", type=int, default=0,
+                    help="paged: megastep controller ceiling (0 = follow "
+                         "--megastep)")
+    ap.add_argument("--inflight", type=int, default=2,
+                    help="paged: dispatch pipelining depth")
     ap.add_argument("--config", default=None,
                     help="TOML deployment file; [tutoring] model/tp apply")
     args = ap.parse_args()
@@ -301,7 +334,10 @@ def main() -> None:
     extra = dict(spec_tokens=args.spec_tokens, greedy=args.greedy)
     run = bench_tpu
     if args.paged:
-        run = partial(bench_paged, chunk=args.chunk)
+        run = partial(bench_paged, chunk=args.chunk,
+                      megastep=args.megastep,
+                      megastep_max=args.megastep_max,
+                      inflight=args.inflight)
     quant = (run(args.model, args.tp, quant=True, batch=args.batch, **extra)
              if args.tp == 1 else None)
     tpu = run(args.model, args.tp, batch=args.batch, **extra)
@@ -311,6 +347,8 @@ def main() -> None:
         name += f"_tp{args.tp}"
     if args.paged:
         name += "_paged"
+    if args.paged and args.megastep > 1:
+        name += f"_mega{args.megastep}"
     if args.greedy:
         name += "_greedy"
     if args.spec_tokens:
@@ -331,6 +369,21 @@ def main() -> None:
     }
     if "requests_per_s" in head:
         record["requests_per_s"] = round(head["requests_per_s"], 2)
+    if "megastep" in head:
+        # Paged runs carry the megastep configuration and its target
+        # ratio so the recorded trajectory shows host round trips per
+        # token shrinking as K rises.
+        record["chunk"] = head["chunk"]
+        record["megastep"] = head["megastep"]
+        record["megastep_max"] = head["megastep_max"]
+        record["inflight"] = head["inflight"]
+        if head.get("host_dispatches_per_token") is not None:
+            record["host_dispatches_per_token"] = round(
+                head["host_dispatches_per_token"], 4
+            )
+        record["megastep_dead_lane_tokens"] = (
+            head["megastep_dead_lane_tokens"]
+        )
     if head.get("spec_tokens_per_window") is not None:
         record["spec_tokens_per_window"] = round(
             head["spec_tokens_per_window"], 2

@@ -80,7 +80,20 @@ class TutoringConfig:
     max_wait_ms: float = 10.0
     slots: Optional[int] = None
     chunk: int = 16              # paged: tokens (spec: verify windows) per
-    #                              dispatched step program
+    #                              device chunk (one step program; a
+    #                              megastep fuses K of them per dispatch)
+    megastep: int = 1            # paged: the K controller's starting rung —
+    #                              chunks fused into one device-resident
+    #                              dispatch (1 = the plain chunk loop)
+    megastep_max: int = 0        # paged: controller ceiling; K grows toward
+    #                              it while the pending queue is empty and,
+    #                              under load, is capped at the chunks until
+    #                              the next guaranteed slot-free (0 = follow
+    #                              `megastep`). Worst-case admission wait is
+    #                              K*chunk device steps.
+    inflight: int = 2            # paged: dispatched-but-unread programs kept
+    #                              in flight (dispatch pipelining depth;
+    #                              1 = serialized dispatch-sync-reap)
     auth_key_file: Optional[str] = None
 
     @property

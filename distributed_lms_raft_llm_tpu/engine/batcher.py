@@ -288,10 +288,14 @@ class PagedQueue:
     Same submit()/start()/close() surface as `BatchingQueue`, different
     scheduling: instead of coalescing a group and running it to completion,
     the worker drives the paged engine step by step — new submissions are
-    drained into the engine *between* decode steps, so a request arriving
-    mid-decode joins the running batch at the next step rather than queueing
-    behind the whole group (the reference serves strictly one at a time —
-    reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
+    drained into the engine *between* dispatches, so a request arriving
+    mid-decode joins the running batch at the next dispatch boundary (one
+    chunk away, or up to K chunks when the engine is running megasteps;
+    the engine's K controller aligns megastep boundaries with the next
+    guaranteed slot-free while anything waits, so a waiting request joins
+    no later than the chunk loop would have admitted it) rather than
+    queueing behind the whole group (the reference serves strictly one at
+    a time — reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
     """
 
     def __init__(self, engine, metrics=None, max_queue: int = 0):
@@ -310,6 +314,10 @@ class PagedQueue:
         # Cumulative per-program (count, wall_s) since queue start; each
         # request snapshots it at submit and diffs at completion.
         self._prog_cum: Dict[str, List[float]] = {}  # guarded-by: event-loop
+        # Cumulative engine dispatch/token counts feeding the
+        # host_dispatches_per_token gauge (a run ratio, not a window one).
+        self._dispatch_cum = 0                       # guarded-by: event-loop
+        self._token_cum = 0                          # guarded-by: event-loop
         self._runner: Optional[asyncio.Task] = None  # guarded-by: event-loop
         self._closed = False                         # guarded-by: event-loop
 
@@ -468,6 +476,28 @@ class PagedQueue:
                 if self.metrics is not None:
                     for ttft in ttfts.values():
                         self.metrics.hist("ttft").observe(ttft)
+                    # Megastep efficiency: the controller's live K, pad
+                    # lanes burnt by mid-megastep finishes, and the run's
+                    # host-dispatches-per-token ratio (the number the
+                    # megastep exists to shrink).
+                    mk = getattr(self.engine, "megastep_k", None)
+                    if mk is not None:
+                        self.metrics.set_gauge("megastep_k", float(mk))
+                    pop_ds = getattr(self.engine, "pop_dispatch_stats",
+                                     None)
+                    if pop_ds is not None:
+                        dispatches, tokens, dead = pop_ds()
+                        if dead:
+                            self.metrics.inc(
+                                "megastep_dead_lane_tokens", dead
+                            )
+                        self._dispatch_cum += dispatches
+                        self._token_cum += tokens
+                        if self._token_cum:
+                            self.metrics.set_gauge(
+                                "host_dispatches_per_token",
+                                self._dispatch_cum / self._token_cum,
+                            )
                     spec = getattr(self.engine, "pop_spec_stats",
                                    lambda: None)()
                     if spec is not None:

@@ -17,7 +17,7 @@ Layout differences from the bucketed path (both by design):
   one readback — see `_step_program` for why chunking is load-bearing on
   high-dispatch-latency links.
 
-Three jitted programs, compiled once each:
+Four jitted program families, compiled once each:
 - `_prefill`: one prompt through the model into a fresh single-slot cache,
   first token sampled;
 - `_install`: splices a prefilled slot into the live donated state;
@@ -29,7 +29,20 @@ Three jitted programs, compiled once each:
   device-side transcript, one forward over the window, exact rejection
   sampling (`engine.draft`, shared with `engine.spec`) — rows accept
   different counts, so slot lengths advance raggedly between host
-  dispatches and the host reaps a per-window token count.
+  dispatches and the host reaps a per-window token count;
+- `_megastep`: K chunks of `_step`/`_spec_step` back-to-back on device
+  (`_megastep_program`, a scan over the chunk body), so the host pays one
+  dispatch + one async readback per K*chunk tokens instead of per chunk.
+  Per-chunk token planes and active-mask snapshots come back stacked
+  (`[K, chunk, S, ...]` / `[K, S]`) for one batched host reap; slots that
+  finish mid-megastep burn pad lanes until the boundary (counted on
+  device — `megastep_dead_lane_tokens`) instead of forcing a host reap.
+  Admission joins at megastep boundaries; a TTFT-aware controller
+  (`next_megastep_k`) grows K toward `megastep_max` when idle and, while
+  admissions are waiting, caps K at the guaranteed-admission horizon
+  (chunks until some live slot MUST free, `_slack_chunks`) — wide under
+  saturation, down to the chunk loop exactly at the boundary a waiting
+  request can actually join.
 
 The reference has no analogue (HF `generate`, one request at a time —
 reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
@@ -58,6 +71,7 @@ from ..utils.guards import intended_transfer
 from .draft import build_drafts, verify_window
 from .engine import EngineConfig
 from .generate import pick_bucket
+from .program_inventory import effective_megastep_max, megastep_ladder
 from .sampling import (
     SamplingParams,
     sample_step,
@@ -232,9 +246,13 @@ def _step_program(params, state: SlotState, rng, *, cfg, sampling,
     Returns (state, tokens [chunk, S], active_snapshot [S] int8). The
     snapshot duplicates state.active in a buffer that is NOT part of the
     donated state tuple (int8, so it can never alias the donated bool
-    plane): the pipelined engine dispatches chunk N+1 — donating state N —
-    before reading chunk N's results, and reaping needs post-chunk-N
-    active flags that survive that donation.
+    plane): the pipelined engine dispatches program N+1 — donating state
+    N — before reading N's results, and reaping needs post-chunk active
+    flags that survive that donation. A megastep (`_megastep_program`)
+    scans this same body K times and stacks the per-chunk outputs along a
+    leading K axis ([K, chunk, S] tokens, [K, S] snapshots) — the
+    snapshot/donation invariant is per chunk, so it carries over
+    unchanged; only the host reap granularity moves from one chunk to K.
     """
     tmax = state.cache.k.shape[3]
 
@@ -368,6 +386,111 @@ def _spec_step_program(
     return state, emitted, counts, state.active.astype(jnp.int8)
 
 
+def _megastep_program(params, state: SlotState, rngs, *, cfg, sampling,
+                      eos_id: int, pad_id: int, model, spec_tokens: int,
+                      chunk: int):
+    """K `chunk`-token steps back-to-back on device: one dispatch, one
+    readback, K*chunk decode iterations.
+
+    `rngs` is a stacked [K] key array holding the SAME sequential splits
+    the chunk-loop host would have fed dispatch-by-dispatch, so chunk j of
+    a megastep consumes exactly the key chunk-loop dispatch j would have —
+    outputs are bit-identical to K separate `_step` dispatches (the K axis
+    is encoded in the rngs shape, so each K compiles its own program; the
+    warmed domain is widths x the `megastep_ladder` rungs).
+
+    The scan body is the existing `_step_program`/`_spec_step_program`
+    (selected statically by `spec_tokens`), unchanged; its per-dispatch
+    outputs stack along a leading K axis:
+
+    - plain: (state, toks [K, chunk, S], active [K, S] int8, dead int32)
+    - spec:  (state, emitted [K, chunk, S, k+1], counts [K, chunk, S],
+              active [K, S] int8, dead int32)
+
+    `active[j]` is the post-chunk-j snapshot — the same fresh non-donated
+    plane the single-chunk program returns, K of them — so the host's
+    batched reap can walk the [K*chunk, S] token plane with the final
+    snapshot and the donation/pipelining invariants of `_step_program`
+    carry over unchanged.
+
+    `dead` is the on-device early-dead account in TOKEN positions: a slot
+    that finishes in chunk j cannot be reaped until the megastep boundary,
+    so it burns one pad lane per remaining scan iteration — and in spec
+    mode each lane is a verify window whose forward computes
+    spec_tokens+1 token positions. dead = chunk * lane_tokens * sum over
+    j<K-1 of |slots active at megastep entry but inactive after chunk j|
+    (lane_tokens = spec_tokens+1 when speculating, else 1) — zero at K=1
+    (the host reaps every chunk), and exactly the positions a chunk-loop
+    host reap would have freed. Slots already dead at entry (empty, or
+    reaped earlier) are capacity idle in both modes and do not count.
+    """
+    started = state.active  # read before the scan consumes the donation
+
+    def one_chunk(s: SlotState, r):
+        if spec_tokens:
+            s, emitted, counts, active = _spec_step_program(
+                params, s, r, cfg=cfg, sampling=sampling, eos_id=eos_id,
+                pad_id=pad_id, model=model, spec_tokens=spec_tokens,
+                chunk=chunk,
+            )
+            return s, (emitted, counts, active)
+        s, toks, active = _step_program(
+            params, s, r, cfg=cfg, sampling=sampling, eos_id=eos_id,
+            pad_id=pad_id, model=model, chunk=chunk,
+        )
+        return s, (toks, active)
+
+    state, outs = jax.lax.scan(one_chunk, state, rngs)
+    active = outs[-1]  # [K, S] int8 post-chunk snapshots
+    lane_tokens = chunk * ((spec_tokens + 1) if spec_tokens else 1)
+    dead = jnp.asarray(lane_tokens, jnp.int32) * jnp.sum(
+        (started[None, :] & (active[:-1] == 0)).astype(jnp.int32)
+    )
+    if spec_tokens:
+        emitted, counts, _ = outs
+        return state, emitted, counts, active, dead
+    toks, _ = outs
+    return state, toks, active, dead
+
+
+def next_megastep_k(current: int, ladder: Sequence[int], pending: int,
+                    slack_chunks: Optional[int] = None) -> int:
+    """TTFT-aware megastep size controller (pure; one decision per
+    dispatch). `ladder` is the warmed rung list (`megastep_ladder`,
+    ascending, starting at 1).
+
+    Idle pending queue: nobody is waiting on a boundary, so grow one
+    rung toward `megastep_max` and amortize the host round trip further
+    (the accepted tradeoff: a FUTURE arrival's worst-case admission wait
+    is K*chunk device steps).
+
+    Work waiting for a slot: shrink K — but against the admission
+    OPPORTUNITY, not unconditionally. A waiting request can only be
+    admitted when a slot frees, and the next GUARANTEED free is
+    `slack_chunks` device chunks away (the engine derives it from the
+    live slots' remaining token budgets net of already-dispatched work —
+    see `_slack_chunks`). Boundaries more frequent than that admit
+    nobody; they only forfeit amortization — an unconditional
+    shrink-on-pending pins K=1 under sustained saturation, the exact
+    regime megasteps exist for, and slows the queue drain that
+    dominates TTFT there. So K is capped at the largest rung fitting
+    the slack: megasteps stay wide while no lane can free, step down to
+    1 exactly at the guaranteed-finish boundary (admission timing
+    identical to the chunk loop for budget-bound finishes), and pop
+    back up once the freed lanes are refilled. Early finishes (eos,
+    spec over-acceptance) can still strand a lane for up to the
+    in-progress K*chunk steps — that exposure is the dead-lane account
+    (`megastep_dead_lane_tokens`). slack_chunks=None (no live slot to
+    bound) falls to the floor."""
+    if len(ladder) <= 1:
+        return ladder[0] if ladder else 1
+    if pending <= 0:
+        i = ladder.index(current) if current in ladder else 0
+        return ladder[min(len(ladder) - 1, i + 1)]
+    cap = 1 if slack_chunks is None else max(1, slack_chunks)
+    return max(k for k in ladder if k <= cap)
+
+
 class PagedEngine:
     """Slot-scheduled serving engine with mid-decode admission.
 
@@ -380,19 +503,33 @@ class PagedEngine:
 
     def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None,
                  slots: Optional[int] = None, chunk: int = 16,
-                 inflight: int = 2):
+                 inflight: int = 2, megastep: int = 1,
+                 megastep_max: int = 0):
         enable_compilation_cache()
         self.config = config
         # Tokens per dispatched step program — see _step_program. Mid-chunk
         # admissions wait at most chunk device steps (ms-scale); host
         # round-trips shrink by the same factor.
         self.chunk = max(1, chunk)
-        # Chunk programs kept in flight: at 2 the host dispatches chunk N+1
-        # before reading chunk N's tokens, so the ~100 ms host<->device
-        # round trip overlaps the next chunk's compute instead of
-        # serializing every dispatch (round-4's paged engine gave up ~40%
-        # throughput to exactly this). 1 = the old dispatch-sync-reap loop.
+        # Dispatch programs kept in flight: at 2 the host dispatches
+        # (mega)step N+1 before reading N's tokens, so the ~100 ms
+        # host<->device round trip overlaps the next program's compute
+        # instead of serializing every dispatch (round-4's paged engine
+        # gave up ~40% throughput to exactly this). 1 = the old
+        # dispatch-sync-reap loop; deeper pipelines help when megasteps
+        # make each dispatch long enough to hide several round trips.
         self.inflight_limit = max(1, inflight)
+        # Device-resident megastep decode: `megastep` is the controller's
+        # starting K (chunks fused per dispatch), `megastep_max` its
+        # ceiling (0 = follow `megastep`). K=1 everywhere is exactly the
+        # pre-megastep chunk loop. The controller moves along the warmed
+        # `megastep_ladder` rungs — see next_megastep_k.
+        self.megastep_max = effective_megastep_max(megastep, megastep_max)
+        self.megastep_ks = megastep_ladder(self.megastep_max)
+        self._megastep_initial = max(
+            k for k in self.megastep_ks if k <= max(1, megastep)
+        )
+        self.megastep_k = self._megastep_initial
         self.family, self.cfg = registry.resolve(
             config.model, config.dtype, config.param_dtype
         )
@@ -512,6 +649,17 @@ class PagedEngine:
                         **statics),
                 donate_argnums=(1,),
             )
+        # K>=2 rungs dispatch through the megastep program (K=1 stays on
+        # _step); the K axis rides in on the stacked rng shape, so each
+        # warmed rung is one compiled program per width. Created even when
+        # the ladder is [1] (zero warmed programs) so the inventory guard
+        # sees one stable program set.
+        self._megastep = jax.jit(
+            partial(_megastep_program, eos_id=self.tokenizer.eos_id,
+                    pad_id=self.tokenizer.pad_id, chunk=self.chunk,
+                    spec_tokens=self.spec, **statics),
+            donate_argnums=(1,),
+        )
         # Wrapped in partial like the other programs — NOT for the statics
         # (it has none to bind) but for cache identity: jax.jit shares one
         # program cache across wrappers of the same bare function, so a
@@ -528,14 +676,22 @@ class PagedEngine:
         self.state = self._init_state()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
         self._pending: List[_Request] = []
-        # Dispatched-but-unread chunk programs, oldest first:
+        # Dispatched-but-unread (mega)step programs, oldest first:
         # (tokens device array — [chunk, S] plain / [chunk, S, k+1] spec,
-        #  counts [chunk, S] device array in spec mode else None,
-        #  active [S] int8 device array,
+        #  with a leading K axis ([K, chunk, S(, k+1)]) when the dispatch
+        #  was a megastep,
+        #  counts [(K,) chunk, S] device array in spec mode else None,
+        #  active int8 device array — [S] post-chunk flags, or [K, S]
+        #  per-chunk snapshots for a megastep (the reap flattens the K
+        #  axis and keys dead-slot detection off the FINAL snapshot),
+        #  dead-lane scalar device array for a megastep else None,
         #  slot->request snapshot at dispatch time).
+        # Every device entry is a fresh non-donated buffer (see
+        # _step_program's snapshot note), so chunk-loop and megastep
+        # dispatches pipeline under the same donation invariants.
         self._inflight: List[
             Tuple[jax.Array, Optional[jax.Array], jax.Array,
-                  List[Optional[_Request]]]
+                  Optional[jax.Array], List[Optional[_Request]]]
         ] = []
         self._next_rid = 0
         self.last_ttft_s: Optional[float] = None
@@ -551,6 +707,15 @@ class PagedEngine:
         # Tokens finished requests generated (bench harnesses divide by
         # wall clock for tokens/sec through the serving path).
         self.total_generated_tokens = 0
+        # Megastep efficiency accounting, drained by pop_dispatch_stats():
+        # program dispatches the host issued, tokens emitted to requests
+        # (admission first tokens + reaped stream tokens), and pad lanes
+        # burnt by slots that finished inside a megastep (the on-device
+        # `dead` account). dispatches/tokens is the host-round-trips-per-
+        # token ratio the megastep exists to shrink.
+        self._dispatches = 0
+        self._emitted_tokens = 0
+        self._dead_lane_tokens = 0
         # Flight-recorder observability, drained by the serving queue:
         # (program, wall-clock start, dispatch seconds) per compiled-
         # program dispatch — program names key the inventory entries and
@@ -566,9 +731,24 @@ class PagedEngine:
         """Record one dispatch's host wall time (device compute overlaps
         it under pipelining; the dispatch call is what the serving loop
         actually spends)."""
+        self._dispatches += 1
         self._prog_times.append((name, t0_unix, time.monotonic() - t0))
         if len(self._prog_times) > self._PROG_TIMES_MAX:
             del self._prog_times[: -self._PROG_TIMES_MAX]
+
+    def pop_dispatch_stats(self) -> Tuple[int, int, int]:
+        """Drain (host_dispatches, emitted_tokens, dead_lane_tokens)
+        accumulated since the last call. dispatches/tokens is the host
+        round trips paid per emitted token — the megastep's target ratio;
+        dead_lane_tokens counts pad lanes already-finished slots decoded
+        inside megasteps before the boundary let the host reap them
+        (zero in chunk-loop mode). The serving queue turns these into the
+        `host_dispatches_per_token` gauge and the
+        `megastep_dead_lane_tokens` counter."""
+        out = (self._dispatches, self._emitted_tokens,
+               self._dead_lane_tokens)
+        self._dispatches = self._emitted_tokens = self._dead_lane_tokens = 0
+        return out
 
     def pop_program_times(self) -> List[Tuple[str, float, float]]:
         """Drain (program, start_unix, dispatch_s) recorded since last
@@ -655,7 +835,8 @@ class PagedEngine:
 
     def warmup(self) -> float:
         """Compile the serving program set so no live request pays an XLA
-        compile: the step program at every cache width, each prompt
+        compile: the step program at every cache width, the megastep
+        program at every (cache width, ladder rung K>=2) pair, each prompt
         bucket's prefill, every admissible (prompt bucket, cache width)
         install pair (a short prompt can join a batch running at any wider
         width), and every width-growth transition. Returns seconds."""
@@ -689,6 +870,16 @@ class PagedEngine:
             self.state = self._canon_state(self.state)
             with self.mesh:
                 self.state = self._step(self.params, self.state, rng)[0]
+            # Megastep rungs at this width, fed the post-step state the
+            # live controller hands them (same handoff-coverage argument
+            # as stepping after an install above).
+            for k in self.megastep_ks[1:]:
+                rngs = self._step_keys(k)
+                self.state = self._canon_state(self.state)
+                with self.mesh:
+                    self.state = self._megastep(
+                        self.params, self.state, rngs
+                    )[0]
         for i, wa in enumerate(self.widths):
             for wb in self.widths[i + 1:]:
                 throwaway = self._init_state(wa)
@@ -698,6 +889,12 @@ class PagedEngine:
         rid = self.submit("warmup")
         self.drain()
         self.ttfts.pop(rid, None)
+        # The warmup drain is not serving traffic: drop its dispatch/token
+        # counts (so the first pop_dispatch_stats() reflects live requests
+        # only) and put the controller back on its configured starting rung
+        # (the idle drain grew K toward the ceiling).
+        self.pop_dispatch_stats()
+        self.megastep_k = self._megastep_initial
         return time.monotonic() - t0
 
     @property
@@ -743,6 +940,7 @@ class PagedEngine:
         self.ttfts = {}
         self._prog_times = []
         self._queue_waits = {}
+        self.megastep_k = self._megastep_initial
 
     def _admit(self) -> None:
         # All free slots fill before any host sync: the prefill+install
@@ -817,6 +1015,7 @@ class PagedEngine:
         now = time.monotonic()
         for (slot, req, _), first in zip(admitted, firsts):
             req.tokens = [int(first)]
+            self._emitted_tokens += 1
             self._slot_req[slot] = req
             ttft = now - req.submit_time
             self.ttfts[req.rid] = ttft
@@ -831,6 +1030,48 @@ class PagedEngine:
 
     def _live(self) -> bool:
         return any(r is not None and not r.finished for r in self._slot_req)
+
+    def _step_keys(self, k: int) -> jax.Array:
+        """Stack the next `k` sequential dispatch keys into a [k] key
+        array for a megastep. The host RNG advances exactly as k separate
+        chunk-loop dispatches would have advanced it, so a megastep's
+        chunk j consumes bit-identical randomness to chunk-loop dispatch
+        j (greedy streams are identical by construction; stochastic
+        streams match too whenever the admission interleaving matches)."""
+        keys = []
+        for _ in range(k):
+            self._rng, r = jax.random.split(self._rng)
+            keys.append(r)
+        return jnp.stack(keys)
+
+    def _slack_chunks(self) -> Optional[int]:
+        """Device chunks until some live slot is GUARANTEED to free — the
+        K controller's admission-opportunity horizon (see
+        next_megastep_k). A slot with `rem` budget tokens left must
+        finish within ceil(rem/chunk) chunk iterations (each chunk
+        advances every live slot by at least `chunk` tokens — exactly
+        chunk in plain mode, >= chunk in spec mode at one guaranteed
+        token per verify window), minus one chunk of already-dispatched
+        work per in-flight unreaped chunk (host-known lengths lag the
+        device by the pipeline depth; subtracting the dispatched debt
+        keeps the bound an upper limit, never an overshoot). None when
+        no live slot bounds the horizon. Early eos/over-acceptance can
+        beat the bound — that exposure is the dead-lane account, capped
+        by the in-progress K*chunk."""
+        rem = None
+        for req in self._slot_req:
+            if req is None or req.finished:
+                continue
+            r = req.max_new - len(req.tokens)
+            rem = r if rem is None else min(rem, r)
+        if rem is None:
+            return None
+        chunks = -(-max(0, rem) // self.chunk)  # ceil
+        debt = sum(
+            (active.shape[0] if active.ndim == 2 else 1)
+            for _, _, active, _, _ in self._inflight
+        )
+        return max(0, chunks - debt)
 
     def _canon_state(self, state: SlotState) -> SlotState:
         """Respell the host-state planes' replicated shardings to the one
@@ -852,19 +1093,46 @@ class PagedEngine:
         )
 
     def step(self) -> List[Tuple[int, str]]:
-        """Admit pending requests, dispatch the next `chunk`-token program,
-        reap the oldest in-flight chunk once the pipeline is full.
+        """Admit pending requests, dispatch the next decode program —
+        `chunk` tokens at controller K=1, K chunks fused into one megastep
+        dispatch at K>1 — and reap the oldest in-flight dispatch once the
+        pipeline is full.
 
-        Pipelining (inflight_limit=2 default): the dispatch for chunk N+1
-        goes out BEFORE chunk N's tokens are read back, so the host's
-        ~100 ms readback round trip overlaps chunk N+1's device compute —
+        Pipelining (inflight_limit=2 default): the dispatch for program
+        N+1 goes out BEFORE program N's tokens are read back, so the
+        host's ~100 ms readback round trip overlaps N+1's device compute —
         round-4's serialized loop left the device idle for every readback
         and gave up ~40% throughput to it. Completions therefore surface
-        one step() call after their chunk at steady state; the tail drains
-        in the same call once no live slot remains.
+        one step() call after their dispatch at steady state; the tail
+        drains in the same call once no live slot remains. Admissions join
+        at dispatch boundaries, so the controller (next_megastep_k) sizes
+        K against the waiting work's actual admission opportunity — the
+        guaranteed-finish horizon from _slack_chunks — keeping megasteps
+        wide under saturation and boundaries exact where a pending
+        request can join.
         """
         self._admit()
         if self._live():
+            self.megastep_k = next_megastep_k(
+                self.megastep_k, self.megastep_ks, len(self._pending),
+                self._slack_chunks(),
+            )
+        if self._live() and self.megastep_k > 1:
+            self.state = self._canon_state(self.state)
+            rngs = self._step_keys(self.megastep_k)
+            t0, t0u = time.monotonic(), time.time()
+            with self.mesh:
+                if self.spec:
+                    (self.state, toks, counts, active,
+                     dead) = self._megastep(self.params, self.state, rngs)
+                else:
+                    self.state, toks, active, dead = self._megastep(
+                        self.params, self.state, rngs
+                    )
+                    counts = None
+            self._time_prog("megastep", t0, t0u)
+            self._push_inflight(toks, counts, active, dead)
+        elif self._live():
             self._rng, rng = jax.random.split(self._rng)
             self.state = self._canon_state(self.state)
             t0, t0u = time.monotonic(), time.time()
@@ -879,25 +1147,7 @@ class PagedEngine:
                     )
                     counts = None
             self._time_prog("step", t0, t0u)
-            # No blocking readback here — but START the device->host copies
-            # now, so the chunk's results stream back while later chunks
-            # compute. On the high-latency bench link this is the entire
-            # ballgame: reap-time device_get paid a ~200 ms round trip per
-            # chunk (measured), serializing the loop at ~270 tok/s; with
-            # the copies in flight the same loop measures ~930 tok/s at
-            # chunk=8 and ~1.9k at chunk=32.
-            for arr in (toks, counts, active):
-                if arr is None:
-                    continue
-                try:
-                    arr.copy_to_host_async()
-                except (AttributeError, NotImplementedError):
-                    pass  # backend without async copies: reap still works
-            # The slot snapshot records which request each column belonged
-            # to at dispatch time (a slot reused later belongs to a later
-            # chunk).
-            self._inflight.append((toks, counts, active,
-                                   list(self._slot_req)))
+            self._push_inflight(toks, counts, active, None)
         done: List[Tuple[int, str]] = []
         while self._inflight and (
             len(self._inflight) >= self.inflight_limit
@@ -906,16 +1156,58 @@ class PagedEngine:
         ):
             done.extend(self._reap(*self._inflight.pop(0)))
             # _reap may finish the last live request: the loop condition
-            # re-evaluates _live(), so remaining chunks drain right here.
+            # re-evaluates _live(), so remaining dispatches drain right
+            # here.
         return done
 
-    def _reap(self, toks_dev, counts_dev, active_dev,
+    def _push_inflight(self, toks, counts, active, dead) -> None:
+        """Queue one dispatched program's output buffers for a later reap.
+
+        No blocking readback here — but START the device->host copies
+        now, so the dispatch's results stream back while later programs
+        compute. On the high-latency bench link this is the entire
+        ballgame: reap-time device_get paid a ~200 ms round trip per
+        chunk (measured), serializing the loop at ~270 tok/s; with the
+        copies in flight the same loop measures ~930 tok/s at chunk=8 and
+        ~1.9k at chunk=32 — and a K-chunk megastep rides the same pipe
+        with K-fold fewer round trips.
+        """
+        for arr in (toks, counts, active, dead):
+            if arr is None:
+                continue
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                pass  # backend without async copies: reap still works
+        # The slot snapshot records which request each column belonged
+        # to at dispatch time (a slot reused later belongs to a later
+        # dispatch).
+        self._inflight.append((toks, counts, active, dead,
+                               list(self._slot_req)))
+
+    def _reap(self, toks_dev, counts_dev, active_dev, dead_dev,
               slot_snapshot) -> List[Tuple[int, str]]:
-        """Read one chunk's results and finish the requests it completed."""
+        """Read one dispatch's results — a single chunk, or a megastep's
+        whole [K, chunk, S] plane in one batched pass — and finish the
+        requests it completed."""
         with intended_transfer():  # THE sync point of the engine loop
-            toks = np.asarray(toks_dev)  # [chunk, S(, k+1)]
+            toks = np.asarray(toks_dev)  # [(K,) chunk, S(, k+1)]
             counts = None if counts_dev is None else np.asarray(counts_dev)
-            active = np.asarray(active_dev)  # [S] int8 post-chunk flags
+            # [S] int8 post-chunk flags, or [K, S] per-chunk snapshots
+            active = np.asarray(active_dev)
+            if dead_dev is not None:
+                self._dead_lane_tokens += int(np.asarray(dead_dev))
+        if active.ndim == 2:
+            # Megastep: flatten the K axis into one [K*chunk, S] token
+            # walk (the per-slot scan below is shape-agnostic in its
+            # leading axis). Dead-slot detection keys off the FINAL
+            # snapshot: a slot that died in chunk j padded every later
+            # lane, exactly like a mid-chunk death pads the chunk tail.
+            toks = toks.reshape(toks.shape[0] * toks.shape[1],
+                                *toks.shape[2:])
+            if counts is not None:
+                counts = counts.reshape(-1, counts.shape[-1])
+            active = active[-1]
         done: List[Tuple[int, str]] = []
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
         for slot, req in enumerate(slot_snapshot):
@@ -925,6 +1217,7 @@ class PagedEngine:
                 continue
             finished = False
             dead = not bool(active[slot])
+            n_before = len(req.tokens)
             if counts is None:
                 # Plain step: one token per scan iteration; a dead slot's
                 # column holds pad filler (detected below).
@@ -973,6 +1266,7 @@ class PagedEngine:
                 ):
                     finished = True
                     break
+            self._emitted_tokens += len(req.tokens) - n_before
             if dead:
                 finished = True
             if finished:

@@ -257,9 +257,25 @@ def main(argv=None) -> None:
                         help="paged engine decode slots (default: max batch "
                         "bucket)")
     parser.add_argument("--chunk", type=int, default=16,
-                        help="paged engine tokens per dispatched step "
-                        "program (verify windows when --spec-tokens is "
-                        "set); admission joins at chunk boundaries")
+                        help="paged engine tokens per device chunk "
+                        "(verify windows when --spec-tokens is set); "
+                        "admission joins at dispatch boundaries")
+    parser.add_argument("--megastep", type=int, default=1,
+                        help="paged engine megastep: starting K of the "
+                        "TTFT-aware controller — K chunks run "
+                        "back-to-back on device per host dispatch "
+                        "(1 = the plain chunk loop)")
+    parser.add_argument("--megastep-max", type=int, default=0,
+                        help="megastep controller ceiling: K grows toward "
+                        "this while the pending queue is empty; under "
+                        "load K is capped at the next guaranteed "
+                        "slot-free horizon, holding admission latency "
+                        "(worst-case wait is K*chunk device steps); "
+                        "0 = follow --megastep")
+    parser.add_argument("--inflight", type=int, default=2,
+                        help="paged engine dispatch pipelining depth: "
+                        "programs dispatched before the oldest is read "
+                        "back (1 = serialized)")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
                              "ephemeral); omit to disable")
@@ -294,6 +310,8 @@ def main(argv=None) -> None:
             "max_batch": t.max_batch, "max_wait_ms": t.max_wait_ms,
             "queue_depth": cfg.resilience.queue_depth,
             "slots": t.slots, "chunk": t.chunk,
+            "megastep": t.megastep, "megastep_max": t.megastep_max,
+            "inflight": t.inflight,
             "auth_key_file": t.auth_key_file,
             # store_true flags merge the same way: presence in argv is what
             # marks them explicit, so the file fills only absent ones.
@@ -354,12 +372,16 @@ def main(argv=None) -> None:
     )
     if args.paged:
         # --max-batch bounds concurrency in both modes: it is the decode
-        # slot count here (unless --slots overrides it explicitly).
+        # slot count here (unless --slots overrides it explicitly; with
+        # megastep enabled, raising slots amortizes the per-dispatch host
+        # overhead over more lanes — cluster.toml ships 16).
         # spec_tokens rides in on the EngineConfig: the paged engine
         # verifies per-slot draft windows (chunk then counts verify
-        # WINDOWS per dispatch, up to spec_tokens+1 tokens each).
+        # WINDOWS per chunk, up to spec_tokens+1 tokens each).
         engine = PagedEngine(config, slots=args.slots or args.max_batch,
-                             chunk=args.chunk)
+                             chunk=args.chunk, inflight=args.inflight,
+                             megastep=args.megastep,
+                             megastep_max=args.megastep_max)
     else:
         engine = TutoringEngine(config)
     if not args.no_warmup:

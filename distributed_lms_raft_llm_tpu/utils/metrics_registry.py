@@ -218,6 +218,25 @@ SPEC_ACCEPTED_TOKENS = counter(
     "tokens speculation produced beyond the guaranteed one per verify "
     "window",
 )
+MEGASTEP_K = gauge(
+    "megastep_k",
+    "live megastep controller value: device chunks fused per host "
+    "dispatch (1 = plain chunk loop; grows toward megastep_max when "
+    "idle, capped at the next guaranteed slot-free horizon while "
+    "admissions wait)",
+)
+MEGASTEP_DEAD_LANE_TOKENS = counter(
+    "megastep_dead_lane_tokens",
+    "pad token positions decoded by slots that finished inside a "
+    "megastep before its boundary let the host reap them (spec-mode "
+    "lanes count spec_tokens+1 positions each; megastep overhead, zero "
+    "in chunk-loop mode)",
+)
+HOST_DISPATCHES_PER_TOKEN = gauge(
+    "host_dispatches_per_token",
+    "host program dispatches paid per emitted token on the paged engine "
+    "(cumulative ratio; the megastep exists to shrink it)",
+)
 
 # Per-program engine dispatch wall time (host-side: the time the serving
 # loop spends issuing each compiled program; device compute overlaps it
@@ -241,6 +260,11 @@ ENGINE_PROG_STEP = histogram(
     "paged-engine _step/_spec_step program dispatch wall time (one "
     "chunk of decode scan iterations)",
 )
+ENGINE_PROG_MEGASTEP = histogram(
+    "engine_prog_megastep",
+    "paged-engine _megastep program dispatch wall time (K chunks of "
+    "decode fused into one device-resident dispatch)",
+)
 ENGINE_PROG_GROW = histogram(
     "engine_prog_grow",
     "paged-engine _grow program dispatch wall time (cache width "
@@ -259,6 +283,7 @@ ENGINE_PROGRAM_HISTOGRAMS: Dict[str, str] = {
     "prefill": ENGINE_PROG_PREFILL,
     "install": ENGINE_PROG_INSTALL,
     "step": ENGINE_PROG_STEP,
+    "megastep": ENGINE_PROG_MEGASTEP,
     "grow": ENGINE_PROG_GROW,
     "generate": ENGINE_PROG_GENERATE,
 }

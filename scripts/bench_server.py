@@ -45,6 +45,9 @@ async def run(args) -> dict:
     from distributed_lms_raft_llm_tpu.engine import (
         EngineConfig, PagedEngine, SamplingParams, TutoringEngine,
     )
+    from distributed_lms_raft_llm_tpu.engine.program_inventory import (
+        effective_megastep_max,
+    )
     from distributed_lms_raft_llm_tpu.proto import lms_pb2, rpc
     from distributed_lms_raft_llm_tpu.serving import tutoring_server
     from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
@@ -78,7 +81,10 @@ async def run(args) -> dict:
         **artifacts,
     )
     if args.paged:
-        engine = PagedEngine(config, slots=args.slots, chunk=args.chunk)
+        engine = PagedEngine(config, slots=args.slots, chunk=args.chunk,
+                             inflight=args.inflight,
+                             megastep=args.megastep,
+                             megastep_max=args.megastep_max)
     else:
         engine = TutoringEngine(config)
     engine.warmup()
@@ -145,6 +151,20 @@ async def run(args) -> dict:
         "kv_quant": args.kv_quant,
         "greedy": args.greedy,
         "spec_tokens": args.spec_tokens,
+        # Megastep configuration + the measured host-round-trips ratio
+        # (PagedQueue keeps the gauge current from the engine's drained
+        # dispatch stats; None on the batched engine).
+        "chunk": args.chunk,
+        "megastep": args.megastep,
+        "megastep_max": effective_megastep_max(args.megastep,
+                                               args.megastep_max),
+        "inflight": args.inflight,
+        "host_dispatches_per_token": snap.get("gauges", {}).get(
+            "host_dispatches_per_token"
+        ),
+        "megastep_dead_lane_tokens": snap.get("counters", {}).get(
+            "megastep_dead_lane_tokens"
+        ),
         # Last completed batch's mean (the gauge is last-value); batch
         # counts here are small enough that it is representative, but it
         # is a sample, not a run aggregate. The counter IS an aggregate:
@@ -178,6 +198,14 @@ def main() -> None:
     ap.add_argument("--paged", action="store_true")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--megastep", type=int, default=1,
+                    help="paged megastep: starting K of the controller — "
+                         "device chunks fused per host dispatch")
+    ap.add_argument("--megastep-max", type=int, default=0,
+                    help="megastep controller ceiling (0 = follow "
+                         "--megastep)")
+    ap.add_argument("--inflight", type=int, default=2,
+                    help="paged dispatch pipelining depth")
     ap.add_argument("--quant", default=None, choices=["int8"])
     ap.add_argument("--kv-quant", action="store_true")
     ap.add_argument("--greedy", action="store_true",

@@ -18,6 +18,13 @@ Usage: python scripts/profile_decode.py [--batch 8] [--bf16]
 (--spec-tokens profiles the speculative verify-window loop of
 engine/spec.py instead of the plain 128-step while_loop decode.)
 
+Dispatch-gap mode: `--megastep K` profiles the PAGED engine's host loop
+instead of the device ops — it runs the same workload through the chunk
+loop (K=1) and through K-chunk megasteps, and reports host round trips
+per emitted token plus per-program dispatch wall times before/after, so
+the dispatch-gap share of decode latency is visible without a device
+trace. (Chunk-loop dispatch gaps are what megasteps exist to remove.)
+
 (Methodology per BENCH_NOTES.md: `block_until_ready` does not sync on the
 axon backend — every timed region ends in a host readback.)
 """
@@ -164,6 +171,119 @@ def eval_size(shape: str) -> int:
     return n
 
 
+def profile_megastep(args) -> None:
+    """Host-dispatch-gap profile of the paged engine: the same request
+    mix through the chunk loop and through --megastep K, with host round
+    trips per token and per-program dispatch walls side by side."""
+    import time
+
+    import numpy as np
+
+    from distributed_lms_raft_llm_tpu.engine import (
+        EngineConfig, PagedEngine, SamplingParams,
+    )
+
+    # --model tiny runs the random-init test model so the dispatch-gap
+    # profile works off-chip (CPU-speed smoke of the tooling itself;
+    # dispatch COUNTS are model-independent, only the walls change).
+    tiny = args.model == "tiny"
+    max_new = 16 if tiny else 128
+    paths = {}
+    if not tiny:
+        ckpt_dir = os.path.join(REPO, "data", "gpt2-local")
+        paths = dict(
+            checkpoint=os.path.join(ckpt_dir, "model.safetensors"),
+            vocab_path=os.path.join(ckpt_dir, "vocab.json"),
+            merges_path=os.path.join(ckpt_dir, "merges.txt"),
+        )
+    sampling = (
+        SamplingParams.greedy(max_new_tokens=max_new) if args.greedy
+        else SamplingParams.reference_defaults(max_new_tokens=max_new)
+    )
+    cfg = EngineConfig(
+        model=args.model,
+        sampling=sampling,
+        quant=None if args.bf16 or tiny else "int8",
+        kv_quant=not (args.bf16 or tiny),
+        spec_tokens=args.spec_tokens,
+        length_buckets=(16,) if tiny else (64,),
+        batch_buckets=(args.batch,),
+        **paths,
+    )
+    def run(megastep: int) -> dict:
+        # Re-seeded per run: both the K=1 and K=args.megastep passes must
+        # measure the IDENTICAL workload, or the before/after ratio
+        # compares two different prompt sets.
+        rng = np.random.default_rng(0)
+        eng = PagedEngine(cfg, slots=args.batch, chunk=args.chunk,
+                          megastep=megastep, megastep_max=megastep)
+        plen = 8 if tiny else 48
+        prompts = [
+            eng.tokenizer.decode(
+                rng.integers(0, eng.tokenizer.vocab_size, plen).tolist()
+            )
+            for _ in range(2 * args.batch)
+        ]
+        eng.warmup()
+        eng.pop_dispatch_stats()
+        eng.pop_program_times()
+        t0 = time.monotonic()
+        for p in prompts:
+            eng.submit(p)
+        eng.drain()
+        wall = time.monotonic() - t0
+        dispatches, tokens, dead = eng.pop_dispatch_stats()
+        per_prog: dict = {}
+        for pname, _start, wall_s in eng.pop_program_times():
+            n, tot = per_prog.get(pname, (0, 0.0))
+            per_prog[pname] = (n + 1, tot + wall_s)
+        return {
+            "megastep": megastep,
+            "host_dispatches": dispatches,
+            "emitted_tokens": tokens,
+            "host_dispatches_per_token": (
+                round(dispatches / tokens, 4) if tokens else None
+            ),
+            "megastep_dead_lane_tokens": dead,
+            "tokens_per_sec": round(tokens / wall, 1),
+            "dispatch_wall_ms": {
+                name: {"count": n, "mean_ms": round(tot / n * 1000, 2)}
+                for name, (n, tot) in sorted(per_prog.items())
+            },
+        }
+
+    before = run(1)
+    after = run(args.megastep)
+    out_path = args.out or os.path.join(
+        REPO, "profiles",
+        f"megastep_dispatch_gap_k{args.megastep}_chunk{args.chunk}"
+        f"_batch{args.batch}.json",
+    )
+    payload = {
+        "description": (
+            "Host dispatch-gap profile of the paged engine: identical "
+            f"workload (2x{args.batch} requests, {max_new} new tokens) "
+            "through "
+            f"the chunk loop (megastep=1) and through {args.megastep}-"
+            "chunk device-resident megasteps; host round trips per "
+            "emitted token is the ratio the megastep attacks"
+        ),
+        "chunk": args.chunk,
+        "before": before,
+        "after": after,
+    }
+    with open(out_path, "w") as fh:
+        json.dump(payload, fh, indent=1)
+    print(f"wrote {out_path}")
+    for row in (before, after):
+        print(
+            f"  megastep={row['megastep']:<3} dispatches/token="
+            f"{row['host_dispatches_per_token']} "
+            f"tok/s={row['tokens_per_sec']} "
+            f"dead_lanes={row['megastep_dead_lane_tokens']}"
+        )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=8)
@@ -175,7 +295,21 @@ def main() -> None:
                     help="profile the speculative decode path (pair with "
                          "--greedy; engine/spec.py verify windows)")
     ap.add_argument("--greedy", action="store_true")
+    ap.add_argument("--megastep", type=int, default=0,
+                    help="dispatch-gap mode: profile the PAGED engine's "
+                         "host loop at K-chunk megasteps vs the chunk "
+                         "loop (host round trips per token before/after)")
+    ap.add_argument("--model", default="gpt2", choices=["gpt2", "tiny"],
+                    help="dispatch-gap mode: tiny = random-init test "
+                         "model (CPU-speed smoke of the profile tooling; "
+                         "dispatch counts are model-independent)")
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="paged device chunk size (dispatch-gap mode)")
     args = ap.parse_args()
+
+    if args.megastep:
+        profile_megastep(args)
+        return
 
     import jax
     import numpy as np

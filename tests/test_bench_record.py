@@ -1,0 +1,69 @@
+"""CPU smoke of the BENCH record paths (BENCH_NOTES' still-unmeasured
+`--paged --spec-tokens` configurations).
+
+The real-chip numbers land in BENCH_NOTES when a TPU is attached; these
+seeded tiny-model runs pin the RECORD path meanwhile — both harnesses
+must keep emitting BENCH-schema dicts that carry the paged+spec fields
+AND the new megastep knobs (megastep/megastep_max/chunk/inflight plus the
+measured host-dispatches-per-token ratio), so the recording command
+cannot rot between measurement rounds.
+"""
+
+import argparse
+import asyncio
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "scripts"))
+
+
+def test_bench_paged_spec_record_smoke():
+    """bench.py's engine-direct paged+spec measurement: one seeded tiny
+    run, record carries throughput + acceptance + megastep knobs."""
+    from bench import bench_paged
+
+    out = bench_paged(
+        model="tiny", batch=2, spec_tokens=2, greedy=True, chunk=2,
+        megastep=2, megastep_max=4, max_new=8, rounds=1, prompt_len=8,
+        length_buckets=(8, 16),
+    )
+    assert out["tokens_per_sec_per_chip"] > 0
+    assert out["requests_per_s"] > 0
+    assert out["ttft_p50_ms"] > 0
+    assert out["chunk"] == 2
+    assert out["megastep"] == 2
+    assert out["megastep_max"] == 4
+    assert out["inflight"] == 2
+    assert 0.0 < out["host_dispatches_per_token"] < 2.0
+    assert out["megastep_dead_lane_tokens"] >= 0
+    # Spec acceptance rides along: mean emitted tokens per verify window
+    # is in [1, k+1] whenever any window ran.
+    assert out["spec_tokens_per_window"] is None or (
+        1.0 <= out["spec_tokens_per_window"] <= 3.0
+    )
+
+
+def test_bench_server_paged_spec_record_smoke():
+    """bench_server.py through the real gRPC stack: the one-line record
+    must carry the paged+spec configuration, the megastep knobs, and the
+    queue-maintained host-dispatches-per-token gauge."""
+    import bench_server
+
+    args = argparse.Namespace(
+        model="tiny", clients=2, queries=1, max_new_tokens=8,
+        paged=True, slots=2, chunk=2, megastep=2, megastep_max=2,
+        inflight=2, quant=None, kv_quant=False, greedy=True,
+        spec_tokens=2,
+    )
+    out = asyncio.run(bench_server.run(args))
+    assert out["engine"] == "paged"
+    assert out["spec_tokens"] == 2
+    assert out["megastep"] == 2
+    assert out["megastep_max"] == 2
+    assert out["chunk"] == 2
+    assert out["tokens_per_sec_per_chip"] > 0
+    assert out["ttft_count"] == 2
+    dpt = out["host_dispatches_per_token"]
+    assert dpt is not None and 0.0 < dpt < 3.0

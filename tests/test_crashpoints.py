@@ -41,6 +41,7 @@ from distributed_lms_raft_llm_tpu.lms.persistence import (
 )
 from distributed_lms_raft_llm_tpu.lms.state import LMSState
 from distributed_lms_raft_llm_tpu.raft import Entry, FileStorage, RaftConfig
+from distributed_lms_raft_llm_tpu.raft.core import NotLeader
 from distributed_lms_raft_llm_tpu.raft.messages import encode_command
 from distributed_lms_raft_llm_tpu.raft.node import MemNetwork
 from distributed_lms_raft_llm_tpu.utils.diskfaults import (
@@ -305,9 +306,18 @@ def test_corrupt_wal_node_rejoins_via_install_snapshot(tmp_path):
         try:
             leader = await _wait_leader(nodes)
             for k in range(10):
-                await leader.node.propose(encode_command(
-                    "SetVal", {"key": f"k{k}", "value": str(k)}
-                ))
+                # Re-resolve on NotLeader: a tick stall under suite load
+                # can re-elect between _wait_leader and the propose.
+                for _ in range(20):
+                    try:
+                        await leader.node.propose(encode_command(
+                            "SetVal", {"key": f"k{k}", "value": str(k)}
+                        ))
+                        break
+                    except NotLeader:
+                        leader = await _wait_leader(nodes)
+                else:
+                    raise AssertionError("leadership never settled")
             # Snapshots every 4 applies: the leader compacted, so a
             # log-less rejoiner can only converge via InstallSnapshot.
             assert await _wait(

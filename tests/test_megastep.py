@@ -1,0 +1,416 @@
+"""Device-resident megastep decode: K chunks per host dispatch.
+
+The megastep changes WHEN the host talks to the device, never WHAT the
+device computes: greedy streams through megasteps (any K) must be
+bit-identical to the chunk-loop paged engine AND the bucketed engine, in
+plain, spec, kv-quant, slot-churn, and mid-megastep-admission scenarios.
+On top of exactness: the TTFT-aware K controller shrinks whenever work
+waits for a slot (the p90-TTFT guard), step-program host dispatches per
+emitted token drop by exactly K at steady state, the on-device dead-lane
+account matches a first-principles derivation, the whole megastep domain
+is warmup-covered (`expected_from_inventory` equality), and the serving
+queue surfaces the new efficiency gauges.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_lms_raft_llm_tpu.engine import (
+    EngineConfig,
+    PagedEngine,
+    PagedQueue,
+    SamplingParams,
+    TutoringEngine,
+)
+from distributed_lms_raft_llm_tpu.engine.paged import (
+    SlotState,
+    _megastep_program,
+    _step_program,
+    next_megastep_k,
+)
+from distributed_lms_raft_llm_tpu.engine.program_inventory import (
+    effective_megastep_max,
+    megastep_ladder,
+)
+from distributed_lms_raft_llm_tpu.models import registry
+from distributed_lms_raft_llm_tpu.utils.guards import (
+    compile_count_guard,
+    expected_from_inventory,
+)
+from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+MAX_NEW = 8
+
+PROMPTS = ["what is raft?", "hello world", "explain paging", "k"]
+
+
+def make_config(**kw):
+    kw.setdefault("sampling", SamplingParams.greedy(max_new_tokens=MAX_NEW))
+    kw.setdefault("length_buckets", (16,))
+    return EngineConfig(
+        model="tiny",
+        batch_buckets=(1, 2, 4),
+        dtype=jnp.float32,
+        **kw,
+    )
+
+
+# ------------------------------------------------------- controller + ladder
+
+
+def test_megastep_ladder_shapes():
+    assert megastep_ladder(1) == [1]
+    assert megastep_ladder(0) == [1]
+    assert megastep_ladder(2) == [1, 2]
+    assert megastep_ladder(8) == [1, 2, 4, 8]
+    assert megastep_ladder(6) == [1, 2, 4, 6]  # ceiling always a rung
+
+
+def test_effective_megastep_max_explicit_ceiling_wins():
+    """An explicitly configured ceiling caps the starting rung (the
+    worst-case admission wait the operator bounded must hold); 0 means
+    follow `megastep`."""
+    assert effective_megastep_max(8, 4) == 4   # ceiling clamps the start
+    assert effective_megastep_max(2, 8) == 8
+    assert effective_megastep_max(4, 0) == 4   # 0 = follow megastep
+    assert effective_megastep_max(0, 0) == 1
+    eng = PagedEngine(make_config(), slots=2, chunk=2,
+                      megastep=8, megastep_max=4)
+    assert eng.megastep_ks == [1, 2, 4]
+    assert eng.megastep_k == 4
+
+
+def test_controller_shrinks_when_pending_queue_nonempty():
+    """The TTFT guard: backlogged work caps K at the guaranteed
+    admission horizon. At the horizon (a slot frees within one chunk) or
+    with no horizon at all, the engine IS the chunk loop — a waiting
+    request is never delayed past the boundary a chunk loop would have
+    admitted it at."""
+    ladder = [1, 2, 4, 8]
+    assert next_megastep_k(8, ladder, pending=1, slack_chunks=1) == 1
+    assert next_megastep_k(8, ladder, pending=3, slack_chunks=0) == 1
+    assert next_megastep_k(8, ladder, pending=1, slack_chunks=None) == 1
+    assert next_megastep_k(4, ladder, pending=1, slack_chunks=3) == 2
+    assert next_megastep_k(8, ladder, pending=1, slack_chunks=5) == 4
+    assert next_megastep_k(1, [1], pending=5, slack_chunks=9) == 1
+
+
+def test_controller_holds_amortization_under_saturation():
+    """A sustained backlog with the next guaranteed slot-free far away
+    must NOT pin K at the floor: boundaries before the horizon admit
+    nobody and only forfeit amortization — this is the saturation regime
+    the megastep exists for, and an unconditional shrink-on-pending
+    would disable it exactly there."""
+    ladder = [1, 2, 4, 8]
+    assert next_megastep_k(1, ladder, pending=16, slack_chunks=64) == 8
+    assert next_megastep_k(8, ladder, pending=16, slack_chunks=8) == 8
+    assert next_megastep_k(2, ladder, pending=1, slack_chunks=4) == 4
+
+
+def test_controller_grows_toward_max_when_idle():
+    ladder = [1, 2, 4, 8]
+    assert next_megastep_k(1, ladder, pending=0) == 2
+    assert next_megastep_k(4, ladder, pending=0) == 8
+    assert next_megastep_k(8, ladder, pending=0) == 8  # ceiling
+    assert next_megastep_k(1, [1], pending=0) == 1     # disabled
+
+
+def test_engine_controller_tracks_admission_horizon():
+    """Through the real engine: a backlog keeps K wide while no slot can
+    free (slack = remaining budget), steps K down to the floor once the
+    dispatched debt covers the guaranteed finish, and pops back up the
+    moment the freed lanes refill — amortization under saturation,
+    chunk-loop admission timing at the boundary."""
+    eng = PagedEngine(make_config(), slots=2, chunk=2,
+                      megastep=4, megastep_max=4)
+    for i in range(6):
+        eng.submit(f"question number {i}")
+    eng.step()  # 2 admitted (7 budget tokens left -> 4-chunk horizon)
+    assert eng.megastep_k == 4
+    eng.step()  # in-flight megastep covers the horizon -> boundary K
+    assert eng.megastep_k == 1
+    eng.step()  # wave reaped, lanes refilled from the backlog -> wide
+    assert eng.megastep_k == 4
+    eng.drain()
+
+
+# ------------------------------------------------------- greedy bit-equality
+
+
+class TestGreedyBitEquality:
+    @pytest.mark.parametrize("megastep", [1, 4])
+    def test_matches_chunk_loop_and_bucketed(self, megastep):
+        """Acceptance pin: megastep K in {1, 4} emits exactly what the
+        chunk-loop paged engine and the bucketed engine emit."""
+        cfg = make_config()
+        expected = TutoringEngine(cfg).answer_batch(list(PROMPTS))
+        plain = PagedEngine(cfg, slots=4, chunk=2)
+        pr = [plain.submit(p) for p in PROMPTS]
+        out_plain = plain.drain()
+        assert [out_plain[r] for r in pr] == expected
+
+        mega = PagedEngine(cfg, slots=4, chunk=2,
+                           megastep=megastep, megastep_max=megastep)
+        mr = [mega.submit(p) for p in PROMPTS]
+        out_mega = mega.drain()
+        assert [out_mega[r] for r in mr] == expected
+
+    @pytest.mark.parametrize("spec_tokens", [1, 3])
+    def test_spec_mode(self, spec_tokens):
+        """Megastep x speculation: K fused chunks of [S, k+1] verify
+        windows must still match the non-spec engines bit for bit."""
+        cfg = make_config(spec_tokens=0)
+        expected = TutoringEngine(cfg).answer_batch(list(PROMPTS))
+        mega = PagedEngine(
+            make_config(spec_tokens=spec_tokens), slots=4, chunk=2,
+            megastep=4, megastep_max=4,
+        )
+        mr = [mega.submit(p) for p in PROMPTS]
+        out = mega.drain()
+        assert [out[r] for r in mr] == expected
+        windows, emitted = mega.pop_spec_stats()
+        assert windows > 0
+        assert windows <= emitted <= windows * (spec_tokens + 1)
+
+    def test_kv_quant(self):
+        cfg = make_config(kv_quant=True)
+        expected = TutoringEngine(cfg).answer_batch(list(PROMPTS[:2]))
+        mega = PagedEngine(cfg, slots=2, chunk=2,
+                           megastep=4, megastep_max=4)
+        mr = [mega.submit(p) for p in PROMPTS[:2]]
+        out = mega.drain()
+        assert [out[r] for r in mr] == expected
+
+    def test_slot_churn_and_prompt_buckets(self):
+        """5 requests over 2 slots with mixed prompt buckets: admissions
+        land at megastep boundaries, the controller moves along the
+        ladder as the backlog drains, and every stream still matches the
+        bucketed engine."""
+        cfg = make_config(length_buckets=(4, 8, 16))
+        prompts = list(PROMPTS) + ["k v"]
+        expected = TutoringEngine(cfg).answer_batch(prompts)
+        mega = PagedEngine(cfg, slots=2, chunk=2,
+                           megastep=2, megastep_max=4)
+        rids = [mega.submit(p) for p in prompts]
+        out = mega.drain()
+        assert [out[r] for r in rids] == expected
+
+    def test_pipelined_megasteps_match_serialized(self):
+        """inflight=2 (dispatch megastep N+1 before reading N) with the
+        stacked [K, chunk, S] reap must produce byte-identical answers."""
+        cfg = make_config()
+        ser = PagedEngine(cfg, slots=2, chunk=2, inflight=1,
+                          megastep=4, megastep_max=4)
+        rs = [ser.submit(p) for p in PROMPTS]
+        out_ser = ser.drain()
+        pipe = PagedEngine(cfg, slots=2, chunk=2, inflight=2,
+                           megastep=4, megastep_max=4)
+        rp = [pipe.submit(p) for p in PROMPTS]
+        out_pipe = pipe.drain()
+        assert [out_pipe[r] for r in rp] == [out_ser[r] for r in rs]
+
+
+def test_mid_megastep_admission_joins_at_next_boundary():
+    """A request submitted while megasteps are in flight is admitted at
+    the next dispatch boundary, and the controller's shrink keeps its
+    wait bounded — it finishes within its own budget, not after A's."""
+    eng = PagedEngine(make_config(), slots=2, chunk=2,
+                      megastep=4, megastep_max=4)
+    eng.submit("a long question about distributed consensus and logs")
+    for _ in range(2):
+        eng.step()  # A mid-decode; megasteps pipelined in flight
+    b = eng.submit("b")
+    finished = {}
+    steps_after_b = 0
+    while eng.has_work and steps_after_b < 3 * MAX_NEW:
+        steps_after_b += 1
+        for rid, _ in eng.step():
+            finished.setdefault(rid, steps_after_b)
+        if steps_after_b == 1:
+            in_slots = {r.rid for r in eng._slot_req if r is not None}
+            assert b in in_slots or b in finished
+    assert b in finished
+    # Each dispatch advances >= chunk tokens for B once admitted; with the
+    # admission + pipelined-reap slack, B cannot have waited for A's
+    # remaining decode.
+    assert finished[b] <= MAX_NEW // 2 + 3
+
+
+# -------------------------------------------------- dispatch amortization
+
+
+def test_step_dispatches_per_token_reduced_4x_at_k4():
+    """The megastep's target number: at K=4 the host pays 4x fewer
+    decode-step dispatches per emitted token than the chunk loop (the
+    per-request prefill+install dispatches are admission constants that
+    megastep does not touch; the chunk loop proper is what it removes).
+    inflight=1 keeps the dispatch count exact (no pipelined overhang)."""
+    max_new = 17  # 1 admission token + 16 decode steps at chunk=1
+    cfg = make_config(
+        sampling=SamplingParams.greedy(max_new_tokens=max_new),
+        length_buckets=(8,),
+    )
+    prompt = "a question about raft elections and paging"
+
+    def run(megastep):
+        eng = PagedEngine(cfg, slots=1, chunk=1, inflight=1,
+                          megastep=megastep, megastep_max=megastep)
+        eng.submit(prompt)
+        eng.drain()
+        dispatches, tokens, _dead = eng.pop_dispatch_stats()
+        steps = sum(
+            1 for name, _, _ in eng.pop_program_times()
+            if name in ("step", "megastep")
+        )
+        return dispatches, tokens, steps
+
+    d1, t1, steps1 = run(1)
+    d4, t4, steps4 = run(4)
+    assert t1 == t4 == max_new, "prompt must use its full budget (no eos)"
+    assert steps1 / steps4 >= 4.0
+    # Total host dispatches per token (admissions included) shrink too.
+    assert d4 / t4 < d1 / t1
+
+
+# ------------------------------------------------------ dead-lane account
+
+
+def test_dead_lane_account_matches_first_principles():
+    """A slot that dies (eos) inside a megastep burns one pad lane per
+    remaining scan iteration; the device-side account must equal
+    chunk * (chunks remaining after the one it died in), derived
+    independently from a chunk-loop discovery run."""
+    family, cfg = registry.resolve("tiny", jnp.float32)
+    params = family.init_params(jax.random.key(0), cfg)
+    sampling = SamplingParams.greedy(max_new_tokens=32)
+    s_slots, t0, width, chunk, k_chunks = 2, 4, 40, 2, 3
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(
+        rng.integers(1, cfg.vocab_size, (s_slots, t0)), jnp.int32
+    )
+    cache = family.init_cache(cfg, s_slots, width, dtype=cfg.dtype)
+    _, cache = family.forward(params, cfg, ids, cache=cache)
+    cache = cache._replace(length=jnp.full((s_slots,), t0, jnp.int32))
+    transcript = jnp.zeros((s_slots, width), jnp.int32)
+    transcript = transcript.at[:, :t0].set(ids)
+    state = SlotState(
+        cache=cache,
+        tok=ids[:, -1],
+        active=jnp.ones((s_slots,), bool),
+        seen=jnp.zeros((s_slots, cfg.vocab_size), bool),
+        transcript=transcript,
+    )
+    statics = dict(cfg=cfg, sampling=sampling, pad_id=0, model=family,
+                   chunk=chunk)
+    # Discovery: with an unreachable eos, greedy decode runs the full
+    # k_chunks * chunk iterations; pick slot 0's token at iteration 1
+    # (mid-chunk-0) as the eos for the measured run.
+    _, toks, _ = _step_program(
+        params, state, jax.random.key(1), eos_id=-1,
+        **dict(statics, chunk=chunk * k_chunks),
+    )
+    toks = np.asarray(toks)  # [chunk*K, S]
+    eos = int(toks[1, 0])
+    # Slot 0 must die in chunk 0 and slot 1 must survive the whole
+    # megastep for the expected count below to be exact.
+    die_iter = int(np.argmax(toks[:, 0] == eos))
+    assert die_iter < chunk
+    assert eos not in toks[:, 1]
+    rngs = jnp.stack([jax.random.key(1)] + [
+        jax.random.key(100 + i) for i in range(k_chunks - 1)
+    ])
+    _, _, active, dead = _megastep_program(
+        params, state, rngs, eos_id=eos, spec_tokens=0, **statics
+    )
+    active = np.asarray(active)
+    assert active[0, 0] == 0 and all(active[:, 1] == 1)
+    # Slot 0 is dead after chunk 0 -> burns chunk lanes in each of the
+    # K-1 remaining chunks; slot 1 never dies -> contributes nothing.
+    assert int(np.asarray(dead)) == chunk * (k_chunks - 1)
+
+
+def test_k1_dispatches_account_no_dead_lanes():
+    """Chunk-loop mode reaps every chunk, so the dead-lane account stays
+    zero by construction."""
+    eng = PagedEngine(make_config(), slots=2, chunk=2)
+    for p in PROMPTS[:2]:
+        eng.submit(p)
+    eng.drain()
+    _, _, dead = eng.pop_dispatch_stats()
+    assert dead == 0
+
+
+# --------------------------------------------- warmup / inventory coverage
+
+
+def test_warmed_megastep_session_passes_inventory_guard():
+    """compile_count_guard(expected_from_inventory(...)): warmup compiles
+    the full megastep domain (widths x ladder rungs >= 2) and a live
+    session that walks the controller across rungs, churns slots, and
+    grows the cache adds ZERO programs."""
+    eng = PagedEngine(
+        make_config(length_buckets=(4, 16)), slots=2, chunk=2,
+        megastep=2, megastep_max=4,
+    )
+    assert eng.megastep_ks == [1, 2, 4]
+    eng.warmup()
+    expectation = expected_from_inventory(eng)
+    assert expectation.expected["_megastep"] == len(eng.widths) * 2
+    assert expectation.mismatches() == {}
+    with compile_count_guard(expectation) as guard:
+        eng.submit("k v")
+        eng.step()
+        eng.submit("a longer question about raft elections and logs")
+        eng.drain()
+        for prompt in ("k v", "a longer question about raft", "k v"):
+            eng.submit(prompt)
+        eng.drain()
+    assert guard.new_compiles() == 0
+
+
+def test_unwarmed_megastep_engine_fails_inventory_guard():
+    from distributed_lms_raft_llm_tpu.utils.guards import RecompileError
+
+    eng = PagedEngine(
+        make_config(length_buckets=(4, 16)), slots=2, chunk=2,
+        megastep=4, megastep_max=4,
+    )
+    with pytest.raises(RecompileError):
+        with compile_count_guard(expected_from_inventory(eng)):
+            eng.submit("hello")
+            eng.drain()
+
+
+# ------------------------------------------------------- serving queue
+
+
+def test_paged_queue_reports_megastep_metrics():
+    """The serving path surfaces megastep efficiency: the live K gauge,
+    the host-dispatches-per-token ratio, and (when megasteps strand
+    finished slots) the dead-lane counter."""
+    metrics = Metrics()
+    engine = PagedEngine(make_config(), slots=2, chunk=2,
+                         megastep=2, megastep_max=4)
+
+    async def run():
+        q = PagedQueue(engine, metrics=metrics)
+        await q.start()
+        answers = await asyncio.gather(
+            *[q.submit(f"query number {i}") for i in range(4)]
+        )
+        await q.close()
+        return answers
+
+    answers = asyncio.run(run())
+    assert len(answers) == 4
+    snap = metrics.snapshot()
+    assert snap["gauges"]["megastep_k"] in {
+        float(k) for k in engine.megastep_ks
+    }
+    dpt = snap["gauges"]["host_dispatches_per_token"]
+    assert 0.0 < dpt < 2.0
+    assert metrics.hist("ttft").snapshot()["count"] == 4
