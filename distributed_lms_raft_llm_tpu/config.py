@@ -94,6 +94,15 @@ class TutoringConfig:
     inflight: int = 2            # paged: dispatched-but-unread programs kept
     #                              in flight (dispatch pipelining depth;
     #                              1 = serialized dispatch-sync-reap)
+    prefix_cache: bool = False   # paged: radix shared-prefix KV cache —
+    #                              prompts sharing a course/assignment
+    #                              context prefill it once; later requests
+    #                              splice the cached blocks and prefill
+    #                              only their uncached suffix
+    prefix_cache_blocks: int = 512  # paged: device-block budget of the
+    #                              shared-prefix tree (16 tokens/block);
+    #                              ref-count-pinned blocks are never
+    #                              evicted, LRU leaves go first
     auth_key_file: Optional[str] = None
 
     @property
@@ -203,8 +212,18 @@ class SimConfig:
     days: float = 1.0             # diurnal cycles compressed into the run
     workers: int = 8              # client worker threads driving the trace
     llm_budget_s: float = 10.0    # per-ask_llm overall client budget
-    tutoring_engine: str = "echo"  # "echo" (wire-complete stand-in) or
-    #                                "tiny" (real JAX engine, tier-2 soak)
+    course_concentration: float = 0.0  # 0 = actors hash uniformly onto
+    #                                courses and ask_llm prompts stay bare;
+    #                                > 0 skews actors toward the first
+    #                                courses AND prefixes on-topic asks
+    #                                with their course's deterministic
+    #                                assignment context (the shared-prefix
+    #                                cache's target workload); 1 = all
+    #                                traffic on course0
+    tutoring_engine: str = "echo"  # "echo" (wire-complete stand-in),
+    #                                "tiny" (real JAX engine, tier-2 soak),
+    #                                or "tiny-paged" (real paged engine +
+    #                                shared-prefix radix cache)
     events: bool = True           # run the operations schedule (transfer,
     #                               quarantine, membership, chaos campaign)
     slo_answer_p95_s: float = 6.0    # ask_llm p95 bound (client + /metrics)
@@ -212,10 +231,10 @@ class SimConfig:
     slo_tick_stalls_max: int = 50    # bound on summed raft_tick_stalls
 
     def __post_init__(self) -> None:
-        if self.tutoring_engine not in ("echo", "tiny"):
+        if self.tutoring_engine not in ("echo", "tiny", "tiny-paged"):
             raise ValueError(
-                f"[sim] tutoring_engine must be 'echo' or 'tiny', "
-                f"got {self.tutoring_engine!r}"
+                f"[sim] tutoring_engine must be 'echo', 'tiny', or "
+                f"'tiny-paged', got {self.tutoring_engine!r}"
             )
         if self.students < 1 or self.workers < 1 or self.duration_s <= 0:
             raise ValueError("[sim] needs students/workers >= 1 and "
@@ -224,6 +243,8 @@ class SimConfig:
             raise ValueError("[sim] needs courses/instructors >= 1")
         if self.base_rate <= 0:
             raise ValueError("[sim] base_rate must be > 0")
+        if not 0.0 <= self.course_concentration <= 1.0:
+            raise ValueError("[sim] course_concentration must be in [0, 1]")
 
 
 @dataclasses.dataclass

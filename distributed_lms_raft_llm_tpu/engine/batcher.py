@@ -280,6 +280,11 @@ class _ReqTrace:
     submitted_unix: float
     queued_s: float           # filled once the engine reports the wait
     prog_snapshot: Dict[str, Tuple[float, float]]
+    # Shared-prefix cache hit at admission (prompt tokens spliced from
+    # the radix tree; None until the engine reports it, stays None on
+    # engines without the prefix contract). Attributed to the request's
+    # engine.prefill/engine.partial_prefill span as prefix_hit_tokens.
+    prefix_hit: Optional[int] = None
 
 
 class PagedQueue:
@@ -318,6 +323,10 @@ class PagedQueue:
         # host_dispatches_per_token gauge (a run ratio, not a window one).
         self._dispatch_cum = 0                       # guarded-by: event-loop
         self._token_cum = 0                          # guarded-by: event-loop
+        # Cumulative shared-prefix hit/prompt tokens feeding the
+        # prefix_cache_hit_rate gauge (same run-ratio shape).
+        self._prefix_hit_cum = 0                     # guarded-by: event-loop
+        self._prefix_prompt_cum = 0                  # guarded-by: event-loop
         self._runner: Optional[asyncio.Task] = None  # guarded-by: event-loop
         self._closed = False                         # guarded-by: event-loop
 
@@ -498,6 +507,30 @@ class PagedQueue:
                                 "host_dispatches_per_token",
                                 self._dispatch_cum / self._token_cum,
                             )
+                    prefix = getattr(self.engine, "pop_prefix_stats",
+                                     lambda: None)()
+                    if prefix is not None:
+                        # Shared-prefix cache effectiveness: tokens whose
+                        # KV came from the radix tree, the eviction
+                        # pressure, the live block level, and the run's
+                        # cumulative hit rate.
+                        hit, total, evicted, blocks_used = prefix
+                        if hit:
+                            self.metrics.inc("prefix_cache_hit_tokens",
+                                             hit)
+                        if evicted:
+                            self.metrics.inc("prefix_cache_evictions",
+                                             evicted)
+                        self.metrics.set_gauge("prefix_cache_blocks_used",
+                                               float(blocks_used))
+                        self._prefix_hit_cum += hit
+                        self._prefix_prompt_cum += total
+                        if self._prefix_prompt_cum:
+                            self.metrics.set_gauge(
+                                "prefix_cache_hit_rate",
+                                self._prefix_hit_cum
+                                / self._prefix_prompt_cum,
+                            )
                     spec = getattr(self.engine, "pop_spec_stats",
                                    lambda: None)()
                     if spec is not None:
@@ -543,6 +576,15 @@ class PagedQueue:
                 cum = self._prog_cum.setdefault(pname, [0.0, 0.0])
                 cum[0] += 1.0
                 cum[1] += wall_s
+        pop_hits = getattr(self.engine, "pop_prefix_hits", None)
+        if pop_hits is not None:
+            # Per-request shared-prefix hit length, reported once at the
+            # request's admission; attached to its prefill span at
+            # completion.
+            for rid, hit in pop_hits().items():
+                entry = self._spans.get(rid)
+                if entry is not None:
+                    entry.prefix_hit = hit
 
     def _finish_span(self, rid: int) -> None:
         """Synthesize the request's `engine.decode` span: admission (end
@@ -568,7 +610,14 @@ class PagedQueue:
             wall_s = cum[1] - before[1]
             if n <= 0:
                 continue
+            attrs: Dict[str, Any] = dict(shared=True, dispatches=n)
+            if (entry.prefix_hit is not None
+                    and pname in ("prefill", "partial_prefill")):
+                # The request's own admission fact (not a shared
+                # aggregate): prompt tokens spliced from the
+                # shared-prefix cache instead of re-prefilled.
+                attrs["prefix_hit_tokens"] = entry.prefix_hit
             espan.child_timed(
                 f"engine.{pname}", t_unix + queued_s,
-                min(wall_s, total_s), shared=True, dispatches=n,
+                min(wall_s, total_s), **attrs,
             )

@@ -19,7 +19,17 @@ Layout differences from the bucketed path (both by design):
 
 Four jitted program families, compiled once each:
 - `_prefill`: one prompt through the model into a fresh single-slot cache,
-  first token sampled;
+  first token sampled. With the shared-prefix cache enabled
+  (`prefix_cache=True`), admission first looks the prompt up in a radix
+  tree of immutable device-resident KV block runs
+  (`engine/prefix_cache.py`): on a hit, `_load_block` splices the cached
+  blocks into a fresh prompt-bucket cache and `_partial_prefill` runs the
+  forward over only the uncached suffix (positions/attention offsets
+  starting at the shared-prefix length), producing the same
+  (cache, first token, seen row) contract cold prefill feeds `_install`;
+  completed prefills publish their prompt's block runs back into the tree
+  (`_export_block`), ref-count-pinned by live slots and LRU-evicted under
+  a block budget;
 - `_install`: splices a prefilled slot into the live donated state;
 - `_step`: [S,1] last-tokens forward with per-row cache offsets (the
   models' ragged-slot scatter path), fused sampling, lengths/active
@@ -71,6 +81,13 @@ from ..utils.guards import intended_transfer
 from .draft import build_drafts, verify_window
 from .engine import EngineConfig
 from .generate import pick_bucket
+from .prefix_cache import (
+    BLOCK_TOKENS,
+    KVBlock,
+    Match,
+    PrefixCache,
+    plan_partial,
+)
 from .program_inventory import effective_megastep_max, megastep_ladder
 from .sampling import (
     SamplingParams,
@@ -164,6 +181,92 @@ def _prefill_program(params, ids, true_len, rng, *, cfg, sampling, model):
     seen = seen_mask_from_ids(ids, valid, cfg.vocab_size)[0]
     first = sample_step(rng, last[None, :], seen[None, :], sampling)[0]
     return cache, first, update_seen(seen[None, :], first[None])[0]
+
+
+def _partial_prefill_program(params, cache0: KVCache, ids_full, ids_suf,
+                             prefix_len, true_len, rng, *, cfg, sampling,
+                             model):
+    """Prefill only the uncached suffix of a shared-prefix prompt.
+
+    `cache0` is a prompt-bucket-wide single-slot cache whose first
+    `prefix_len` positions hold KV spliced from the radix tree
+    (`_load_block_program`); `ids_full` is the [1, t] right-padded FULL
+    prompt (seen-mask seed — identical to what cold prefill consumes),
+    `ids_suf` the [1, s] right-padded uncached suffix. The forward runs
+    over the suffix only: KV scatters at offset `prefix_len` and
+    positions default to the cache slot indices, so positions/attention
+    offsets start at the shared-prefix length — each real suffix query
+    attends causally over [0, prefix_len + j], exactly the key set the
+    cold [1, t] prefill masks in for the same position (the pad tails
+    differ only in garbage no valid query can attend to — the same
+    causal-frontier argument as `_spec_step_program`'s window). The last
+    real suffix position IS the prompt's last position, so sampling from
+    its logits with the cold path's rng split and the full-prompt seen
+    mask makes a cache-hit first token bit-identical to the cold one;
+    the decode path downstream is untouched and inherits the equality
+    (pinned across plain/spec/kv-quant/megastep in
+    tests/test_prefix_cache.py).
+
+    Returns (cache [.., t, ..], first, seen_row) — the exact contract
+    `_install_program` consumes from `_prefill_program`.
+    """
+    _, t = ids_full.shape
+    suf_len = true_len - prefix_len
+    logits, cache = model.forward(
+        params, cfg, ids_suf, cache=cache0._replace(length=prefix_len)
+    )
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0], suf_len - 1, 0, keepdims=False
+    )
+    valid = (jnp.arange(t) < true_len)[None, :]
+    seen = seen_mask_from_ids(ids_full, valid, cfg.vocab_size)[0]
+    first = sample_step(rng, last[None, :], seen[None, :], sampling)[0]
+    return cache, first, update_seen(seen[None, :], first[None])[0]
+
+
+def _load_block_program(cache0: KVCache, block: KVBlock, off) -> KVCache:
+    """Splice one immutable shared KV block into a fresh single-slot
+    prefill cache at token offset `off` (one compiled program per prompt
+    bucket; the block width is an engine constant). Donates the
+    accumulator `cache0` — a private buffer mid-assembly — and NEVER the
+    block: tree blocks are shared structure (engine/prefix_cache.py),
+    and donating one would free KV that other admissions still splice
+    from (reversion-pinned in tests/test_lint_clean.py)."""
+    zero = jnp.zeros((), jnp.int32)
+    off = jnp.asarray(off, jnp.int32)
+    k = jax.lax.dynamic_update_slice(cache0.k, block.k,
+                                     (zero, zero, zero, off, zero))
+    v = jax.lax.dynamic_update_slice(cache0.v, block.v,
+                                     (zero, zero, zero, off, zero))
+    ks = vs = None
+    if cache0.quantized:
+        ks = jax.lax.dynamic_update_slice(cache0.ks, block.ks,
+                                          (zero, zero, zero, off))
+        vs = jax.lax.dynamic_update_slice(cache0.vs, block.vs,
+                                          (zero, zero, zero, off))
+    return cache0._replace(k=k, v=v, ks=ks, vs=vs)
+
+
+def _export_block_program(c1: KVCache, off, *, block: int) -> KVBlock:
+    """Slice one block-aligned KV run out of a completed prefill's cache
+    — a fresh immutable copy the radix tree owns. Publishing copies
+    rather than aliasing: `c1` is transient admission state, and a tree
+    that aliased it would see its buffers donated away by the next
+    install."""
+    l, b, h, _, dh = c1.k.shape
+    zero = jnp.zeros((), jnp.int32)
+    off = jnp.asarray(off, jnp.int32)
+    k = jax.lax.dynamic_slice(c1.k, (zero, zero, zero, off, zero),
+                              (l, b, h, block, dh))
+    v = jax.lax.dynamic_slice(c1.v, (zero, zero, zero, off, zero),
+                              (l, b, h, block, dh))
+    ks = vs = None
+    if c1.quantized:
+        ks = jax.lax.dynamic_slice(c1.ks, (zero, zero, zero, off),
+                                   (l, b, h, block))
+        vs = jax.lax.dynamic_slice(c1.vs, (zero, zero, zero, off),
+                                   (l, b, h, block))
+    return KVBlock(k=k, v=v, ks=ks, vs=vs)
 
 
 def cfg_tmax(cfg, sampling: SamplingParams, bucket: int) -> int:
@@ -504,7 +607,9 @@ class PagedEngine:
     def __init__(self, config: EngineConfig, devices: Optional[Sequence] = None,
                  slots: Optional[int] = None, chunk: int = 16,
                  inflight: int = 2, megastep: int = 1,
-                 megastep_max: int = 0):
+                 megastep_max: int = 0, prefix_cache: bool = False,
+                 prefix_cache_blocks: int = 512,
+                 prefix_block_tokens: int = BLOCK_TOKENS):
         enable_compilation_cache()
         self.config = config
         # Tokens per dispatched step program — see _step_program. Mid-chunk
@@ -612,6 +717,21 @@ class PagedEngine:
             + self._spec_extra
             for b in config.length_buckets
         })
+        # The warmed prompt buckets (one prefill program each; partial
+        # prefill compiles per admissible (bucket, suffix-bucket) pair).
+        self.buckets = sorted({
+            min(b, self.bucket) for b in config.length_buckets
+        })
+        # Shared-prefix KV cache (engine/prefix_cache.py): a radix tree
+        # of immutable device-resident block runs; admission splices the
+        # longest cached prefix and partial-prefills only the suffix.
+        self.prefix_block_tokens = max(1, prefix_block_tokens)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache:
+            self.prefix_cache = PrefixCache(
+                block_tokens=self.prefix_block_tokens,
+                max_blocks=max(1, prefix_cache_blocks),
+            )
 
         if config.checkpoint:
             sd = convert.load_safetensors(config.checkpoint)
@@ -628,6 +748,21 @@ class PagedEngine:
 
         statics = dict(cfg=self.cfg, sampling=config.sampling, model=self.family)
         self._prefill = jax.jit(partial(_prefill_program, **statics))
+        # Shared-prefix programs. Created even with the cache disabled
+        # (zero warmed programs then) so the inventory guard sees one
+        # stable program set — the _megastep precedent. The partial
+        # prefill donates the spliced cache0 accumulator; the block
+        # splice donates ONLY the accumulator, never the shared block.
+        self._partial_prefill = jax.jit(
+            partial(_partial_prefill_program, **statics),
+            donate_argnums=(1,),
+        )
+        self._load_block = jax.jit(
+            partial(_load_block_program), donate_argnums=(0,),
+        )
+        self._export_block = jax.jit(
+            partial(_export_block_program, block=self.prefix_block_tokens),
+        )
         # The live SlotState is donated on every program that replaces it, so
         # admissions and steps update the multi-slot KV cache in place instead
         # of copying it (a full cache round-trip of HBM traffic otherwise).
@@ -724,8 +859,26 @@ class PagedEngine:
         # a queue-less caller (bench drain loops) cannot grow them.
         self._prog_times: List[Tuple[str, float, float]] = []
         self._queue_waits: Dict[int, float] = {}
+        # Shared-prefix accounting: per-rid pinned tree paths (released
+        # when the request completes — eviction never frees a block a
+        # live slot references), per-rid hit lengths for tracing, and
+        # the cumulative hit/prompt/eviction counts pop_prefix_stats()
+        # drains into the prefix_cache_* metric series.
+        self._prefix_pins: Dict[int, Match] = {}
+        self._prefix_hits: Dict[int, int] = {}
+        self._prefix_hit_tokens = 0
+        self._prefix_prompt_tokens = 0
+        self._prefix_evictions = 0
 
     _PROG_TIMES_MAX = 4096
+
+    def _shed_oldest(self, d: Dict[int, object]) -> None:
+        """Bound a per-rid dict for queue-less callers (bench drain
+        loops, warmup) that never pop it: past the cap, drop the oldest
+        half rather than grow forever."""
+        if len(d) > self._PROG_TIMES_MAX:
+            for rid in list(d)[: -self._PROG_TIMES_MAX // 2]:
+                d.pop(rid, None)
 
     def _time_prog(self, name: str, t0: float, t0_unix: float) -> None:
         """Record one dispatch's host wall time (device compute overlaps
@@ -748,6 +901,32 @@ class PagedEngine:
         out = (self._dispatches, self._emitted_tokens,
                self._dead_lane_tokens)
         self._dispatches = self._emitted_tokens = self._dead_lane_tokens = 0
+        return out
+
+    def pop_prefix_stats(self) -> Optional[Tuple[int, int, int, int]]:
+        """Drain (hit_tokens, prompt_tokens, evicted_blocks, blocks_used)
+        accumulated since the last call; None when the shared-prefix
+        cache is disabled. hit_tokens counts prompt tokens whose KV was
+        spliced from the radix tree instead of re-prefilled (the USED
+        prefix after bucket fitting, not the raw match) and
+        prompt_tokens the total prompt tokens admitted, so
+        hit/prompt is the hit rate; blocks_used is the live tree level
+        the budget is enforced on. The serving queue turns these into
+        `prefix_cache_hit_tokens`/`prefix_cache_evictions` counters and
+        the `prefix_cache_hit_rate`/`prefix_cache_blocks_used` gauges."""
+        if self.prefix_cache is None:
+            return None
+        out = (self._prefix_hit_tokens, self._prefix_prompt_tokens,
+               self._prefix_evictions, self.prefix_cache.blocks_used)
+        self._prefix_hit_tokens = self._prefix_prompt_tokens = 0
+        self._prefix_evictions = 0
+        return out
+
+    def pop_prefix_hits(self) -> Dict[int, int]:
+        """Drain rid -> shared-prefix tokens spliced at that request's
+        admission (0 = cold prefill). Feeds the per-request
+        `engine.prefill` span attributes on the trace."""
+        out, self._prefix_hits = self._prefix_hits, {}
         return out
 
     def pop_program_times(self) -> List[Tuple[str, float, float]]:
@@ -839,11 +1018,12 @@ class PagedEngine:
         program at every (cache width, ladder rung K>=2) pair, each prompt
         bucket's prefill, every admissible (prompt bucket, cache width)
         install pair (a short prompt can join a batch running at any wider
-        width), and every width-growth transition. Returns seconds."""
+        width), every width-growth transition, and — with the
+        shared-prefix cache enabled — the block export/load programs per
+        bucket plus every admissible (bucket, suffix-bucket) partial
+        prefill. Returns seconds."""
         t0 = time.monotonic()
-        buckets = sorted(
-            {min(b, self.bucket) for b in self.config.length_buckets}
-        )
+        buckets = self.buckets
         for width in self.widths:
             self.state = self._init_state(width)
             for t in buckets:
@@ -885,10 +1065,55 @@ class PagedEngine:
                 throwaway = self._init_state(wa)
                 with self.mesh:
                     self._grow(throwaway, wb)
+        if self.prefix_cache is not None:
+            # Shared-prefix program domain: one export/load program per
+            # prompt bucket wide enough to hold a block, one partial
+            # prefill per admissible (bucket, suffix-bucket) pair —
+            # plan_partial can only pick a suffix bucket that leaves at
+            # least one whole block of prefix in the window. Dynamic
+            # scalars (offsets, lengths) don't key programs, so pad
+            # prompts with throwaway values cover the full live domain.
+            blk_t = self.prefix_block_tokens
+            for t in buckets:
+                if t < blk_t:
+                    continue  # bucket can't hold one block
+                ids = np.full((1, t), self.tokenizer.pad_id, np.int32)
+                self._rng, rng = jax.random.split(self._rng)
+                with self.mesh:
+                    c1, _, _ = self._prefill(
+                        self.params, jnp.asarray(ids),
+                        jnp.asarray(1, jnp.int32), rng,
+                    )
+                    blk = self._export_block(c1, jnp.asarray(0, jnp.int32))
+                for s in buckets:
+                    if s > t - blk_t:
+                        continue
+                    ids_suf = np.full((1, s), self.tokenizer.pad_id,
+                                      np.int32)
+                    self._rng, rng = jax.random.split(self._rng)
+                    cache0 = self._fresh_prefill_cache(t)
+                    with self.mesh:
+                        cache0 = self._load_block(
+                            cache0, blk, jnp.asarray(0, jnp.int32)
+                        )
+                        self._partial_prefill(
+                            self.params, cache0, jnp.asarray(ids),
+                            jnp.asarray(ids_suf),
+                            jnp.asarray(blk_t, jnp.int32),
+                            jnp.asarray(blk_t + 1, jnp.int32), rng,
+                        )
         self.reset()  # drop the ghost installs; compiled programs stay cached
         rid = self.submit("warmup")
         self.drain()
         self.ttfts.pop(rid, None)
+        if self.prefix_cache is not None:
+            # The warmup drain published the ghost "warmup" prompt into
+            # the tree; live traffic must start from an empty cache and
+            # zeroed hit accounting.
+            self.prefix_cache.clear()
+            self._prefix_hit_tokens = self._prefix_prompt_tokens = 0
+            self._prefix_evictions = 0
+            self._prefix_hits = {}
         # The warmup drain is not serving traffic: drop its dispatch/token
         # counts (so the first pop_dispatch_stats() reflects live requests
         # only) and put the controller back on its configured starting rung
@@ -941,6 +1166,14 @@ class PagedEngine:
         self._prog_times = []
         self._queue_waits = {}
         self.megastep_k = self._megastep_initial
+        # The radix tree itself SURVIVES a reset: its blocks are never
+        # donated, so a failed step cannot have deleted them — only the
+        # per-request pins die with their requests.
+        if self.prefix_cache is not None:
+            for pin in self._prefix_pins.values():
+                self.prefix_cache.release(pin)
+        self._prefix_pins = {}
+        self._prefix_hits = {}
 
     def _admit(self) -> None:
         # All free slots fill before any host sync: the prefill+install
@@ -968,12 +1201,7 @@ class PagedEngine:
                 continue
             req = self._pending.pop(0)
             self._queue_waits[req.rid] = time.monotonic() - req.submit_time
-            if len(self._queue_waits) > self._PROG_TIMES_MAX:
-                # Queue-less callers (bench drain loops, warmup) never
-                # drain: drop the oldest half rather than grow forever.
-                for rid in list(self._queue_waits)[
-                        : -self._PROG_TIMES_MAX // 2]:
-                    self._queue_waits.pop(rid, None)
+            self._shed_oldest(self._queue_waits)
             # Smallest length bucket that fits: a 10-token query prefills a
             # 16/32-wide program, not the full Tmax-wide one (one compiled
             # prefill per bucket; the decode cache runs at the width the
@@ -994,12 +1222,9 @@ class PagedEngine:
                     t0, t0u = time.monotonic(), time.time()
                     self.state = self._grow(self.state, w_req)
                     self._time_prog("grow", t0, t0u)
-                t0, t0u = time.monotonic(), time.time()
-                c1, first, seen_row = self._prefill(
-                    self.params, jnp.asarray(ids),
-                    jnp.asarray(req.prompt_len, jnp.int32), rng,
+                c1, first, seen_row = self._run_prefill(
+                    req, bucket, ids, rng
                 )
-                self._time_prog("prefill", t0, t0u)
                 t0, t0u = time.monotonic(), time.time()
                 self.state = self._install(
                     self.state, jnp.asarray(slot, jnp.int32), c1,
@@ -1027,6 +1252,109 @@ class PagedEngine:
         )
         return (cfg_tmax(self.cfg, self.config.sampling, bucket)
                 + self._spec_extra)
+
+    def _fresh_prefill_cache(self, width: int) -> KVCache:
+        """A zeroed single-slot prompt cache for the block splice, born
+        replicated in the canonical spelling (same reasoning as
+        _init_state: raw single-device arrays would key the splice and
+        partial-prefill programs differently than warmup's)."""
+        cache = self.family.init_cache(
+            self.cfg, 1, width, dtype=self.cfg.dtype
+        )
+        rep = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec()
+        )
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep), cache
+        )
+
+    def _run_prefill(self, req: _Request, bucket: int, ids: np.ndarray,
+                     rng: jax.Array):
+        """One request's prompt into a [1, bucket]-wide cache: a cold
+        full prefill, or — on a shared-prefix cache hit — the cached
+        block runs spliced into a fresh cache plus a partial prefill
+        over only the uncached suffix. Either way the completed prompt's
+        blocks are published back into the tree (a cold miss is what
+        seeds the course context the next request hits), the matched
+        path stays ref-count-pinned until the request finishes, and the
+        caller receives the `_install` contract (c1, first, seen_row).
+        Runs under `self.mesh`; consumes the caller's rng split, so a
+        hit samples the bit-identical first token a cold prefill would.
+        """
+        pc = self.prefix_cache
+        prefix_used = suffix_bucket = 0
+        match: Optional[Match] = None
+        if pc is not None:
+            match = pc.lookup(req.tokens)
+            if match.tokens:
+                prefix_used, suffix_bucket = plan_partial(
+                    match.tokens, req.prompt_len, bucket, self.buckets,
+                    pc.block_tokens,
+                )
+        if prefix_used:
+            pc.acquire(match)
+            self._prefix_pins[req.rid] = match
+            blocks = match.blocks()[: prefix_used // pc.block_tokens]
+            t0, t0u = time.monotonic(), time.time()
+            cache0 = self._fresh_prefill_cache(bucket)
+            for i, blk in enumerate(blocks):
+                cache0 = self._load_block(
+                    cache0, blk,
+                    jnp.asarray(i * pc.block_tokens, jnp.int32),
+                )
+            self._dispatches += max(0, len(blocks) - 1)
+            self._time_prog("load_block", t0, t0u)
+            ids_suf = np.full((1, suffix_bucket), self.tokenizer.pad_id,
+                              np.int32)
+            ids_suf[0, : req.prompt_len - prefix_used] = (
+                req.tokens[prefix_used:]
+            )
+            t0, t0u = time.monotonic(), time.time()
+            c1, first, seen_row = self._partial_prefill(
+                self.params, cache0, jnp.asarray(ids),
+                jnp.asarray(ids_suf),
+                jnp.asarray(prefix_used, jnp.int32),
+                jnp.asarray(req.prompt_len, jnp.int32), rng,
+            )
+            self._time_prog("partial_prefill", t0, t0u)
+        else:
+            t0, t0u = time.monotonic(), time.time()
+            c1, first, seen_row = self._prefill(
+                self.params, jnp.asarray(ids),
+                jnp.asarray(req.prompt_len, jnp.int32), rng,
+            )
+            self._time_prog("prefill", t0, t0u)
+        if pc is not None:
+            self._publish(req, c1)
+            self._prefix_hit_tokens += prefix_used
+            self._prefix_prompt_tokens += req.prompt_len
+            self._prefix_hits[req.rid] = prefix_used
+            self._shed_oldest(self._prefix_hits)
+        return c1, first, seen_row
+
+    def _publish(self, req: _Request, c1: KVCache) -> None:
+        """Publish the completed prefill's whole prompt blocks into the
+        radix tree — immutable copies sliced out of c1, inserted only
+        for blocks the tree does not already hold — then enforce the
+        block budget (after insert, so a publish can never evict blocks
+        its own admission still references; pinned paths are never
+        evicted regardless)."""
+        pc = self.prefix_cache
+        blk_t = pc.block_tokens
+        t0, t0u = time.monotonic(), time.time()
+
+        def make_block(i: int) -> KVBlock:
+            return self._export_block(
+                c1, jnp.asarray(i * blk_t, jnp.int32)
+            )
+
+        added = pc.insert(
+            req.tokens[: (req.prompt_len // blk_t) * blk_t], make_block
+        )
+        if added:
+            self._dispatches += added - 1
+            self._time_prog("export_block", t0, t0u)
+        self._prefix_evictions += pc.evict_to_budget()
 
     def _live(self) -> bool:
         return any(r is not None and not r.finished for r in self._slot_req)
@@ -1271,6 +1599,11 @@ class PagedEngine:
                 finished = True
             if finished:
                 req.finished = True
+                pin = self._prefix_pins.pop(req.rid, None)
+                if pin is not None and self.prefix_cache is not None:
+                    # The slot no longer reads shared blocks: unpin its
+                    # matched path so eviction may reclaim it.
+                    self.prefix_cache.release(pin)
                 self.total_generated_tokens += len(req.tokens)
                 text = self.tokenizer.decode(
                     [t for t in req.tokens if t != eos]

@@ -276,6 +276,17 @@ def main(argv=None) -> None:
                         help="paged engine dispatch pipelining depth: "
                         "programs dispatched before the oldest is read "
                         "back (1 = serialized)")
+    parser.add_argument("--prefix-cache", action="store_true",
+                        help="paged engine radix shared-prefix KV cache: "
+                        "prompts sharing a course/assignment context "
+                        "prefill it once; later requests splice the "
+                        "cached blocks and prefill only their suffix "
+                        "(hit rate in /metrics prefix_cache_hit_rate; "
+                        "ignored without --paged)")
+    parser.add_argument("--prefix-cache-blocks", type=int, default=512,
+                        help="shared-prefix cache block budget (16 "
+                        "tokens/block; LRU eviction, blocks referenced "
+                        "by live slots are never freed)")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
                              "ephemeral); omit to disable")
@@ -312,6 +323,8 @@ def main(argv=None) -> None:
             "slots": t.slots, "chunk": t.chunk,
             "megastep": t.megastep, "megastep_max": t.megastep_max,
             "inflight": t.inflight,
+            "prefix_cache": t.prefix_cache,
+            "prefix_cache_blocks": t.prefix_cache_blocks,
             "auth_key_file": t.auth_key_file,
             # store_true flags merge the same way: presence in argv is what
             # marks them explicit, so the file fills only absent ones.
@@ -381,8 +394,13 @@ def main(argv=None) -> None:
         engine = PagedEngine(config, slots=args.slots or args.max_batch,
                              chunk=args.chunk, inflight=args.inflight,
                              megastep=args.megastep,
-                             megastep_max=args.megastep_max)
+                             megastep_max=args.megastep_max,
+                             prefix_cache=args.prefix_cache,
+                             prefix_cache_blocks=args.prefix_cache_blocks)
     else:
+        if args.prefix_cache:
+            log.warning("--prefix-cache applies to the paged engine only; "
+                        "ignored without --paged")
         engine = TutoringEngine(config)
     if not args.no_warmup:
         secs = (engine.warmup() if args.paged

@@ -275,6 +275,14 @@ class SimCluster:
         )
         return self._http(req)
 
+    def tutoring_metrics_snapshot(self) -> Dict:
+        """The tutoring node's serving-queue Metrics snapshot (hit
+        rates, shed counters). Snapshot() is thread-safe; {} before
+        boot/after teardown."""
+        if not self._tutoring:
+            return {}
+        return self._tutoring["metrics"].snapshot()
+
     def scrape_all(self) -> tuple:
         """({nid: /metrics}, {nid: /healthz}) for every live node."""
         metrics, health = {}, {}
@@ -339,28 +347,64 @@ class SimCluster:
     # ------------------------------------------------------------ coroutines
 
     async def _boot_tutoring(self) -> None:
-        from ..engine import BatchingQueue
+        from ..engine import BatchingQueue, PagedQueue
 
-        if self.cfg.tutoring_engine == "tiny":
+        queue = None
+        metrics = Metrics()
+        if self.cfg.tutoring_engine in ("tiny", "tiny-paged"):
             import jax
 
-            from ..engine import EngineConfig, SamplingParams, TutoringEngine
+            from ..engine import (
+                EngineConfig,
+                PagedEngine,
+                SamplingParams,
+                TutoringEngine,
+            )
 
-            engine = TutoringEngine(EngineConfig(
+            config = EngineConfig(
                 model="tiny",
                 sampling=SamplingParams(max_new_tokens=8),
                 length_buckets=(32,), batch_buckets=(1, 2, 4),
                 dtype=jax.numpy.float32,
-            ))
+            )
+            if self.cfg.tutoring_engine == "tiny-paged":
+                # The real serving configuration scaled down: paged
+                # continuous batching with the shared-prefix radix
+                # cache, so a concentrated same-course workload
+                # (`course_concentration` > 0) produces a measurable
+                # prefix_cache_hit_rate in the soak's verdict. Two
+                # prompt buckets + 8-token blocks: the tiny position
+                # table caps prompts at 32 tokens, and a partial
+                # prefill needs a suffix bucket that leaves at least
+                # one whole block of prefix in the window. NOTE the
+                # 32-token cap also tail-truncates the long course
+                # context, so at this scale hits come from students
+                # repeating the same course question verbatim — real
+                # lookup/splice/partial-prefill traffic, but not
+                # cross-question context sharing (that is bench.py's
+                # shared-prefix scenario, with token-level control).
+                import dataclasses as _dc
+
+                engine = PagedEngine(
+                    _dc.replace(config, length_buckets=(16, 32)),
+                    slots=4, chunk=4, prefix_cache=True,
+                    prefix_cache_blocks=128, prefix_block_tokens=8,
+                )
+                queue = PagedQueue(engine, metrics=metrics, max_queue=64)
+            else:
+                engine = TutoringEngine(config)
             # Compile now, while this loop runs nothing else: tutoring
             # boots BEFORE the Raft nodes, so the XLA compile can't stall
             # their tick loops (every node shares this loop+GIL).
-            engine.warmup(batch=4)
+            if queue is not None:
+                engine.warmup()
+            else:
+                engine.warmup(batch=4)
         else:
             engine = EchoEngine()
-        metrics = Metrics()
-        queue = BatchingQueue(engine, max_batch=4, max_wait_ms=5.0,
-                              metrics=metrics, max_queue=64)
+        if queue is None:
+            queue = BatchingQueue(engine, max_batch=4, max_wait_ms=5.0,
+                                  metrics=metrics, max_queue=64)
         await queue.start()
         server = grpc.aio.server()
         rpc.add_TutoringServicer_to_server(

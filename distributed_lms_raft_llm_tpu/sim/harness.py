@@ -128,6 +128,7 @@ class SemesterSim:
                 self.metrics.snapshot(), self.ledger.report(),
                 event_failures=scheduler.failures(),
                 traces=traces,
+                tutoring_metrics=self.cluster.tutoring_metrics_snapshot(),
                 metrics=self.metrics,
             )
             return self._record(ops, plan, scheduler, report, node_metrics,
@@ -523,6 +524,11 @@ class SemesterSim:
             "students": self.cfg.students,
             "duration_s": self.cfg.duration_s,
             "tutoring_engine": self.cfg.tutoring_engine,
+            "course_concentration": self.cfg.course_concentration,
+            # Measured shared-prefix KV cache hit rate on the tutoring
+            # node (None unless the engine runs the radix cache, i.e.
+            # tutoring_engine = "tiny-paged").
+            "prefix_cache_hit_rate": report.prefix_cache_hit_rate,
             "trace_digest": wl.trace_digest(ops),
             "event_digest": _event_digest(plan),
             "ops_planned": len(ops),

@@ -53,6 +53,12 @@ class SloReport:
     stage_p95s: Dict[str, Dict[str, float]] = dataclasses.field(
         default_factory=dict
     )
+    # Measured shared-prefix KV cache hit rate on the tutoring node
+    # (prefix_cache_hit_rate gauge); None when the serving engine runs
+    # without the cache (echo stand-in, bucketed engine). Informational
+    # — carried in the verdict and the BENCH record, not a pass/fail
+    # bound.
+    prefix_cache_hit_rate: Any = None
 
     @property
     def ok(self) -> bool:
@@ -68,6 +74,7 @@ class SloReport:
                                 "bound": c.bound}
                        for c in self.checks},
             "stage_p95s": self.stage_p95s,
+            "prefix_cache_hit_rate": self.prefix_cache_hit_rate,
         }
 
 
@@ -121,13 +128,16 @@ def evaluate_slos(
     *,
     event_failures: Sequence[Dict] = (),
     traces: Sequence[Dict[str, Any]] = (),
+    tutoring_metrics: Dict = None,
     metrics=None,
 ) -> SloReport:
     """`node_metrics`/`node_health`: node id -> scraped JSON snapshots of
     every node alive at the end of the run; `sim_metrics`: the harness's
     own Metrics snapshot; `ledger_report`: `WriteLedger.report()`;
     `event_failures`: the scheduler's `ok=False` outcomes; `traces`: the
-    flight recorder's retained trace trees (per-stage breakdowns)."""
+    flight recorder's retained trace trees (per-stage breakdowns);
+    `tutoring_metrics`: the tutoring node's serving-queue snapshot (the
+    verdict carries its measured prefix_cache_hit_rate)."""
     checks: List[SloCheck] = []
 
     def check(name: str, ok: bool, observed: str, bound: str) -> None:
@@ -199,4 +209,8 @@ def evaluate_slos(
           f"{len(failed)} failed" + (f": {failed[:3]}" if failed else ""),
           "every planned event ok")
 
-    return SloReport(checks=checks, stage_p95s=stage_breakdown(traces))
+    hit_rate = (tutoring_metrics or {}).get("gauges", {}).get(
+        "prefix_cache_hit_rate"
+    )
+    return SloReport(checks=checks, stage_p95s=stage_breakdown(traces),
+                     prefix_cache_hit_rate=hit_rate)

@@ -108,11 +108,39 @@ class WorkloadGenerator:
         self.instructors = [f"instructor{i}" for i in range(cfg.instructors)]
 
     def course_of(self, actor: str) -> str:
-        """Static assignment: actors hash onto courses."""
-        return self.courses[
-            int(hashlib.sha1(actor.encode()).hexdigest(), 16)
-            % len(self.courses)
-        ]
+        """Static assignment: actors hash onto courses. With
+        `course_concentration` > 0 the hash is skewed geometrically
+        toward the first courses (1.0 = everyone on course0) — the
+        same-course traffic regime the tutoring engine's shared-prefix
+        KV cache targets. Still a pure function of the actor name, so
+        the trace stays seed-deterministic."""
+        h = int(hashlib.sha1(actor.encode()).hexdigest(), 16)
+        c = self.cfg.course_concentration
+        if c <= 0:
+            return self.courses[h % len(self.courses)]
+        weights = [(1.0 - c) ** i for i in range(len(self.courses))]
+        u = (h % 10**9) / 10**9 * sum(weights)
+        acc = 0.0
+        for i, w in enumerate(weights):
+            acc += w
+            if u < acc:
+                return self.courses[i]
+        return self.courses[-1]
+
+    def course_context(self, course: str) -> str:
+        """The deterministic course/assignment context on-topic asks are
+        prefixed with under `course_concentration` > 0: every student in
+        a course asks against the SAME context text, so their prompts
+        share the token prefix the radix cache prefills once. Caveat at
+        sim scale: the tiny tutoring model's position table is narrower
+        than this context, and the engine keeps a prompt's TAIL — so in
+        the tiny-paged soak the measured hits come from students
+        repeating the same course question verbatim (still the radix
+        partial-prefill path); genuine cross-question context sharing is
+        exercised with token-level control by bench.py's shared-prefix
+        scenario and tests/test_prefix_cache.py."""
+        return (f"{course} assignment context: {ASSIGNMENT_TEXT} "
+                f"Course question: ")
 
     def rate(self, t_s: float) -> float:
         """Diurnal ops/s at offset `t_s`: `days` sine cycles compressed
@@ -169,7 +197,13 @@ class WorkloadGenerator:
                        "text": f"{ASSIGNMENT_TEXT} (revision "
                                f"{counters['submit']:04d} by {actor})"}
         elif kind == ASK_LLM_ON_TOPIC:
-            payload = {"query": rng.choice(ON_TOPIC_QUERIES)}
+            q = rng.choice(ON_TOPIC_QUERIES)
+            if self.cfg.course_concentration > 0:
+                # Shared course context: the prompt prefix is identical
+                # for every on-topic ask in this course (off-topic asks
+                # stay bare so the relevance gate keeps discriminating).
+                q = self.course_context(course) + q
+            payload = {"query": q}
         elif kind == ASK_LLM_OFF_TOPIC:
             payload = {"query": rng.choice(OFF_TOPIC_QUERIES)}
         elif kind == ASK_INSTRUCTOR:

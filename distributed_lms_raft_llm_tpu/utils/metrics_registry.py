@@ -237,6 +237,27 @@ HOST_DISPATCHES_PER_TOKEN = gauge(
     "host program dispatches paid per emitted token on the paged engine "
     "(cumulative ratio; the megastep exists to shrink it)",
 )
+PREFIX_CACHE_HIT_TOKENS = counter(
+    "prefix_cache_hit_tokens",
+    "prompt tokens whose KV was spliced from the shared-prefix radix "
+    "cache instead of being re-prefilled (the device time the cache "
+    "saves)",
+)
+PREFIX_CACHE_EVICTIONS = counter(
+    "prefix_cache_evictions",
+    "shared-prefix KV blocks evicted under the block budget (LRU "
+    "unpinned leaves; blocks a live slot references are never freed)",
+)
+PREFIX_CACHE_BLOCKS_USED = gauge(
+    "prefix_cache_blocks_used",
+    "shared-prefix KV blocks currently resident in the radix tree "
+    "(may transiently exceed the budget while every leaf is pinned)",
+)
+PREFIX_CACHE_HIT_RATE = gauge(
+    "prefix_cache_hit_rate",
+    "cumulative fraction of admitted prompt tokens served from the "
+    "shared-prefix cache (hit tokens / prompt tokens since queue start)",
+)
 
 # Per-program engine dispatch wall time (host-side: the time the serving
 # loop spends issuing each compiled program; device compute overlaps it
@@ -265,6 +286,11 @@ ENGINE_PROG_MEGASTEP = histogram(
     "paged-engine _megastep program dispatch wall time (K chunks of "
     "decode fused into one device-resident dispatch)",
 )
+ENGINE_PROG_PARTIAL_PREFILL = histogram(
+    "engine_prog_partial_prefill",
+    "paged-engine _partial_prefill program dispatch wall time (a "
+    "shared-prefix cache hit's suffix-only prompt pass)",
+)
 ENGINE_PROG_GROW = histogram(
     "engine_prog_grow",
     "paged-engine _grow program dispatch wall time (cache width "
@@ -281,6 +307,7 @@ ENGINE_PROG_GENERATE = histogram(
 # declared namespace (see BREAKER_TRANSITION_COUNTERS).
 ENGINE_PROGRAM_HISTOGRAMS: Dict[str, str] = {
     "prefill": ENGINE_PROG_PREFILL,
+    "partial_prefill": ENGINE_PROG_PARTIAL_PREFILL,
     "install": ENGINE_PROG_INSTALL,
     "step": ENGINE_PROG_STEP,
     "megastep": ENGINE_PROG_MEGASTEP,

@@ -81,10 +81,13 @@ async def run(args) -> dict:
         **artifacts,
     )
     if args.paged:
-        engine = PagedEngine(config, slots=args.slots, chunk=args.chunk,
-                             inflight=args.inflight,
-                             megastep=args.megastep,
-                             megastep_max=args.megastep_max)
+        engine = PagedEngine(
+            config, slots=args.slots, chunk=args.chunk,
+            inflight=args.inflight, megastep=args.megastep,
+            megastep_max=args.megastep_max,
+            prefix_cache=getattr(args, "prefix_cache", False),
+            prefix_cache_blocks=getattr(args, "prefix_cache_blocks", 512),
+        )
     else:
         engine = TutoringEngine(config)
     engine.warmup()
@@ -175,6 +178,19 @@ async def run(args) -> dict:
         "spec_accepted_tokens": snap.get("counters", {}).get(
             "spec_accepted_tokens"
         ),
+        # Shared-prefix cache effectiveness (None when disabled or on
+        # the batched engine): run-cumulative hit rate plus the raw
+        # hit-token and eviction counters the queue maintains.
+        "prefix_cache": getattr(args, "prefix_cache", False),
+        "prefix_cache_hit_rate": snap.get("gauges", {}).get(
+            "prefix_cache_hit_rate"
+        ),
+        "prefix_cache_hit_tokens": snap.get("counters", {}).get(
+            "prefix_cache_hit_tokens"
+        ),
+        "prefix_cache_evictions": snap.get("counters", {}).get(
+            "prefix_cache_evictions"
+        ),
         "ttft_p90_ms": round(ttft.get("p90_s", 0.0) * 1000, 2),
         "ttft_count": ttft.get("count", 0),
         "answer_p50_s": round(answer_lat[n // 2], 3),
@@ -206,6 +222,11 @@ def main() -> None:
                          "--megastep)")
     ap.add_argument("--inflight", type=int, default=2,
                     help="paged dispatch pipelining depth")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="paged radix shared-prefix KV cache (hit rate "
+                         "lands in the record)")
+    ap.add_argument("--prefix-cache-blocks", type=int, default=512,
+                    help="shared-prefix cache block budget")
     ap.add_argument("--quant", default=None, choices=["int8"])
     ap.add_argument("--kv-quant", action="store_true")
     ap.add_argument("--greedy", action="store_true",

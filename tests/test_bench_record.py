@@ -14,6 +14,8 @@ import asyncio
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(REPO / "scripts"))
@@ -45,6 +47,46 @@ def test_bench_paged_spec_record_smoke():
     )
 
 
+def test_bench_shared_prefix_record_smoke():
+    """bench.py's shared-prefix scenario: N requests against one common
+    course context; the record must carry prefill ms and tokens/s cold
+    vs warm plus the measured hit rate (>= 50% shared-prefix tokens at
+    steady state — the ISSUE acceptance workload)."""
+    from bench import bench_shared_prefix
+
+    out = bench_shared_prefix(
+        model="tiny", n_requests=6, prefix_len=24, suffix_len=8,
+        max_new=8, chunk=2, slots=2, prefix_cache_blocks=64,
+        prefix_block_tokens=4, length_buckets=(16, 32, 64),
+    )
+    assert out["metric"] == "paged_shared_prefix_prefill_speedup"
+    assert out["prefill_ms_cold"] > 0
+    assert out["prefill_ms_warm"] > 0
+    # The headline value is the cold/warm ratio (both fields are rounded
+    # independently, so compare with tolerance, not equality).
+    assert out["value"] == pytest.approx(
+        out["prefill_ms_cold"] / out["prefill_ms_warm"], abs=0.02
+    )
+    assert out["tokens_per_sec_per_chip_cold"] > 0
+    assert out["tokens_per_sec_per_chip_warm"] > 0
+    # The warm phase really shares >= 50% of its prompt tokens; the cold
+    # phase (distinct contexts) must not.
+    assert out["prefix_cache_hit_rate"] >= 0.5
+    assert out["cold_hit_rate"] < 0.1
+
+
+def test_bench_paged_carries_prefix_knob_and_hit_rate():
+    from bench import bench_paged
+
+    out = bench_paged(
+        model="tiny", batch=2, greedy=True, chunk=2, max_new=8,
+        rounds=1, prompt_len=8, length_buckets=(8, 16),
+        prefix_cache_blocks=16,
+    )
+    assert out["prefix_cache_blocks"] == 16
+    assert out["prefix_cache_hit_rate"] is not None
+
+
 def test_bench_server_paged_spec_record_smoke():
     """bench_server.py through the real gRPC stack: the one-line record
     must carry the paged+spec configuration, the megastep knobs, and the
@@ -67,3 +109,7 @@ def test_bench_server_paged_spec_record_smoke():
     assert out["ttft_count"] == 2
     dpt = out["host_dispatches_per_token"]
     assert dpt is not None and 0.0 < dpt < 3.0
+    # Prefix-cache fields ride along (disabled here: knob recorded False,
+    # gauge absent => None, never fabricated).
+    assert out["prefix_cache"] is False
+    assert out["prefix_cache_hit_rate"] is None

@@ -239,6 +239,27 @@ def test_removing_warmup_coverage_fails_lint():
         "program-inventory"
 
 
+def test_donating_a_shared_prefix_block_fails_lint():
+    """PR-10 acceptance pin: shared-prefix tree blocks are immutable
+    shared structure — an in-place write (donation) to a shared block
+    plane would free KV other admissions still splice from. Donating the
+    block argument of the splice program must fail donation-safety."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.donation_safety import (
+        DonationSafetyRule,
+    )
+
+    project = _project_with_patch(PAGED, (
+        "partial(_load_block_program), donate_argnums=(0,),",
+        "partial(_load_block_program), donate_argnums=(0, 1),",
+    ))
+    findings = [
+        f for f in DonationSafetyRule().check_project(project)
+        if f.path == PAGED and "blk" in f.message
+    ]
+    assert findings, "a donated shared block plane must fail " \
+        "donation-safety"
+
+
 def test_uninventoried_jit_entry_fails_lint():
     from distributed_lms_raft_llm_tpu.analysis.rules.program_inventory import (
         ProgramInventoryRule,

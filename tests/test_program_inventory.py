@@ -68,7 +68,8 @@ def test_manifest_and_readme_match_static_scan():
 
 def test_manifest_covers_the_paged_program_set():
     attrs = {e.attr for e in inv.entries_for("PagedEngine")}
-    assert attrs == {"_prefill", "_install", "_step", "_megastep", "_grow"}
+    assert attrs == {"_prefill", "_install", "_step", "_megastep", "_grow",
+                     "_partial_prefill", "_load_block", "_export_block"}
     assert all(
         e.coverage == "warmup" for e in inv.entries_for("PagedEngine")
     ), "the paged engine's whole program set is a warmup promise"
@@ -86,6 +87,16 @@ def test_static_domain_math_is_engine_math():
         )
         assert dom["widths"] == list(eng.widths)
         assert max(dom["buckets"]) <= eng.bucket
+    # The shared-prefix domain: zero with the cache off, the admissible
+    # (bucket, suffix-bucket) pairs (one whole block of prefix must fit
+    # the window) with it on.
+    off = inv.static_paged_domain(64, 8, (8, 16), 0)
+    assert off["partial_pairs"] == off["export_buckets"] == 0
+    on = inv.static_paged_domain(64, 8, (8, 16), 0, prefix_cache=True,
+                                 prefix_block_tokens=4)
+    assert on["partial_pairs"] == 1   # only (t=16, s=8) admits a block
+    assert on["export_buckets"] == 2  # both buckets can publish
+    assert on["load_buckets"] == 1    # only t=16 can splice
 
 
 # ------------------------------------------------- runtime cross-validation
