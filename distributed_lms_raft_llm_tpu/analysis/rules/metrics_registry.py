@@ -19,7 +19,14 @@ its declarations as pure AST and proves, project-wide:
   rule must be able to read them without importing), no duplicates, no
   empty help strings;
 - every declared series is **emitted somewhere** — a stale declaration
-  would put a dead row in the README table the registry renders.
+  would put a dead row in the README table the registry renders;
+- snapshot/timeline **reads** are checked like emissions: the series
+  name handed to the shared readers (`utils/timeline.snap_counter/
+  snap_gauge/snap_hist`) and to the Timeline window queries
+  (`counter_rate`, `hist_p95`, ...) must be declared too — an SLO bound
+  or dashboard row naming a never-declared series would silently read 0
+  forever, the read-side twin of the typo'd emission. Reads do NOT count
+  as emissions (a series someone only reads is still dead).
 
 Truly dynamic names (the generic `LoopWatchdog`'s `f"{name}_lag"`)
 carry a visible `# lint: disable=metrics-registry` with the wiring site
@@ -40,12 +47,31 @@ _EMIT_METHODS = {"inc", "set_gauge", "hist", "time"}
 # Receivers that denote a Metrics object: `metrics.inc(...)`,
 # `self.metrics.inc(...)`, `self._metrics.inc(...)`.
 _METRICS_RECEIVERS = {"metrics", "_metrics"}
+# Snapshot/timeline READ sites: function/method name -> positional index
+# of the series-name argument (also accepted as keyword `name`). These
+# names are the shared reader vocabulary from utils/timeline.py; calls
+# to them anywhere in the watched tree are checked like emissions.
+_READ_FUNCS: Dict[str, int] = {
+    "snap_counter": 1,
+    "snap_gauge": 1,
+    "snap_hist": 1,
+    "counter_rate": 0,
+    "counter_delta": 0,
+    "hist_rate": 0,
+    "hist_p95": 0,
+    "gauge_last": 0,
+    "gauge_percentile": 0,
+}
 
 DEFAULT_WATCH = ("distributed_lms_raft_llm_tpu/",)
 DEFAULT_EXCLUDE = (
     # The Metrics implementation itself and the declaration point.
     "distributed_lms_raft_llm_tpu/utils/metrics.py",
     "distributed_lms_raft_llm_tpu/utils/" + REGISTRY_FILENAME,
+    # The timeline/scrape mechanism: these DEFINE the generic readers
+    # (their internal calls flow parameters, not policy names).
+    "distributed_lms_raft_llm_tpu/utils/timeline.py",
+    "distributed_lms_raft_llm_tpu/utils/scrape.py",
 )
 
 
@@ -64,6 +90,41 @@ def _is_metrics_call(call: ast.Call) -> bool:
 def _name_arg(call: ast.Call) -> Optional[ast.expr]:
     if call.args:
         return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def _walk_own(fn_node: ast.AST):
+    """Walk a function's body WITHOUT descending into nested def bodies:
+    every nested def is its own FunctionInfo and walks itself, so a
+    nested forwarder's seam is judged against ITS parameter rather than
+    re-walked under the parent (where the parameter looks like a dynamic
+    name). Lambdas are not FunctionInfos and stay in the parent walk."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _read_call_arg(call: ast.Call) -> Optional[ast.expr]:
+    """The series-name argument of a snapshot/timeline read call, or
+    None when `call` is not one of the known readers."""
+    func = call.func
+    fname = (
+        func.attr if isinstance(func, ast.Attribute)
+        else func.id if isinstance(func, ast.Name)
+        else None
+    )
+    if fname is None or fname not in _READ_FUNCS:
+        return None
+    idx = _READ_FUNCS[fname]
+    if len(call.args) > idx:
+        return call.args[idx]
     for kw in call.keywords:
         if kw.arg == "name":
             return kw.value
@@ -183,10 +244,14 @@ class MetricsRegistryRule(ProjectRule):
         # `from ..utils.metrics_registry import TUTORING_DEGRADED`
         return target[0] == "sym" and target[1] == registry_rel
 
-    def _find_forwarders(self, project: Project) -> Dict[str, str]:
-        """qname -> forwarded param name, for helpers that pass their first
-        non-self parameter straight into a metrics primitive."""
-        forwarders: Dict[str, str] = {}
+    def _find_forwarders(self, project: Project) -> Dict[str, Tuple[str,
+                                                                    bool]]:
+        """qname -> (forwarded param name, is_read), for helpers that
+        pass their first non-self parameter straight into a metrics
+        primitive (emission seam) or into one of the snapshot/timeline
+        readers (read seam) — call sites are checked instead of the
+        seam, and read-forwarded names never count as emissions."""
+        forwarders: Dict[str, Tuple[str, bool]] = {}
         for qname, fn in project.functions.items():
             args = fn.node.args.args
             params = [a.arg for a in args if a.arg != "self"]
@@ -194,10 +259,17 @@ class MetricsRegistryRule(ProjectRule):
                 continue
             first = params[0]
             for node in ast.walk(fn.node):
-                if isinstance(node, ast.Call) and _is_metrics_call(node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_metrics_call(node):
                     arg = _name_arg(node)
                     if isinstance(arg, ast.Name) and arg.id == first:
-                        forwarders[qname] = first
+                        forwarders[qname] = (first, False)
+                        break
+                else:
+                    arg = _read_call_arg(node)
+                    if isinstance(arg, ast.Name) and arg.id == first:
+                        forwarders[qname] = (first, True)
                         break
         return forwarders
 
@@ -223,24 +295,31 @@ class MetricsRegistryRule(ProjectRule):
             if fn.rel in self.exclude_rels or fn.rel == registry_rel:
                 continue
             mod = project.modules[fn.rel]
-            own_forward_param = forwarders.get(fn.qname)
-            for node in ast.walk(fn.node):
+            own_forward = forwarders.get(fn.qname)
+            own_forward_param = own_forward[0] if own_forward else None
+            for node in _walk_own(fn.node):
                 if not isinstance(node, ast.Call):
                     continue
+                is_read = False
                 if _is_metrics_call(node):
                     arg = _name_arg(node)
                 else:
-                    callee = project.resolve_call(
-                        mod, node.func, fn.class_name, fn
-                    )
-                    if callee is None or callee.qname not in forwarders:
-                        continue
-                    arg = node.args[0] if node.args else None
+                    arg = _read_call_arg(node)
+                    if arg is not None:
+                        is_read = True
+                    else:
+                        callee = project.resolve_call(
+                            mod, node.func, fn.class_name, fn
+                        )
+                        if callee is None or callee.qname not in forwarders:
+                            continue
+                        is_read = forwarders[callee.qname][1]
+                        arg = node.args[0] if node.args else None
                 if arg is None:
                     continue
-                # Collapse the parent-function re-walk of nested-def
-                # bodies ONLY: col_offset keeps two emissions sharing a
-                # source line distinct.
+                # Defensive dedup (a call reachable from two walks):
+                # col_offset keeps two emissions sharing a source line
+                # distinct.
                 key = (fn.rel, node.lineno, node.col_offset)
                 if key in seen:
                     continue
@@ -253,14 +332,22 @@ class MetricsRegistryRule(ProjectRule):
                 if all(isinstance(b, ast.Constant)
                        and isinstance(b.value, str) for b in branches):
                     for b in branches:
-                        emitted.add(b.value)
+                        if not is_read:
+                            emitted.add(b.value)
                         if b.value not in registry.names:
+                            what = ("read" if is_read else "emission")
+                            why = (
+                                "an SLO bound or dashboard row on it "
+                                "reads 0 forever" if is_read else
+                                "a typo here ships an always-zero "
+                                "dashboard panel"
+                            )
                             findings.append(self.finding(
                                 fn.src, node,
-                                f"metric name {b.value!r} is not declared "
-                                f"in {registry_rel} — a typo here ships an "
-                                "always-zero dashboard panel; declare it "
-                                "with a help string (or fix the spelling)",
+                                f"metric name {b.value!r} at this {what} "
+                                f"site is not declared in {registry_rel} "
+                                f"— {why}; declare it with a help string "
+                                "(or fix the spelling)",
                             ))
                     continue
                 if isinstance(arg, ast.Name) and arg.id == own_forward_param:

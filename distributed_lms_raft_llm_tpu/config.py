@@ -229,8 +229,18 @@ class SimConfig:
     slo_answer_p95_s: float = 6.0    # ask_llm p95 bound (client + /metrics)
     slo_degraded_rate_max: float = 0.5  # degraded answers / llm requests
     slo_tick_stalls_max: int = 50    # bound on summed raft_tick_stalls
+    continuous_slos: bool = True  # evaluate the SLOs in fast/slow burn-rate
+    #                               windows DURING the run (sim/slo.py
+    #                               ContinuousSloEngine over a live cluster
+    #                               scrape), not only at run end; alerts
+    #                               land in the verdict and the BENCH record
+    telemetry_sample_s: float = 0.25  # scrape/evaluate cadence of the
+    #                               in-run telemetry loop (cluster /metrics
+    #                               poll + burn-rate evaluation)
 
     def __post_init__(self) -> None:
+        if self.telemetry_sample_s <= 0:
+            raise ValueError("[sim] telemetry_sample_s must be > 0")
         if self.tutoring_engine not in ("echo", "tiny", "tiny-paged"):
             raise ValueError(
                 f"[sim] tutoring_engine must be 'echo', 'tiny', or "
@@ -273,6 +283,51 @@ class TracingConfig:
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """[telemetry] — the timeline/burn-rate observability plane
+    (utils/timeline.py, utils/scrape.py, scripts/telemetry.py). One
+    section because the knobs trade off as a unit: the sample interval
+    and ring length bound what `GET /admin/timeline` remembers, the
+    fast/slow windows + burn thresholds define when the multi-window
+    burn-rate evaluators page, and the chip ceiling anchors the
+    capacity model's utilization axis.
+    """
+
+    enabled: bool = True            # per-node TimelineSampler + /admin/timeline
+    sample_interval_s: float = 1.0  # node-local snapshot cadence
+    ring_points: int = 600          # retained samples per node (~10 min @ 1 s)
+    fast_window_s: float = 60.0     # paging window: burn must ALSO be
+    #                                 recent (SRE workbook multi-window)
+    slow_window_s: float = 600.0    # sustained-evidence window
+    fast_burn: float = 1.2          # fast-window burn-rate threshold
+    #                                 (consumption rate / budget rate)
+    slow_burn: float = 1.0          # slow-window threshold (>= 1 means the
+    #                                 budget is being spent faster than it
+    #                                 accrues)
+    chip_ceiling_tokens_per_s: float = 61500.0  # measured saturation
+    #                                 throughput per chip (BENCH_NOTES
+    #                                 round 5, int8 batch 128+); the
+    #                                 capacity model's utilization anchor
+
+    def __post_init__(self) -> None:
+        if self.sample_interval_s <= 0 or self.ring_points < 2:
+            raise ValueError(
+                "[telemetry] needs sample_interval_s > 0 and "
+                "ring_points >= 2"
+            )
+        if self.fast_window_s <= 0 or self.slow_window_s < self.fast_window_s:
+            raise ValueError(
+                "[telemetry] needs 0 < fast_window_s <= slow_window_s"
+            )
+        if self.fast_burn <= 0 or self.slow_burn <= 0:
+            raise ValueError("[telemetry] burn thresholds must be > 0")
+        if self.chip_ceiling_tokens_per_s <= 0:
+            raise ValueError(
+                "[telemetry] chip_ceiling_tokens_per_s must be > 0"
+            )
+
+
+@dataclasses.dataclass
 class AppConfig:
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     tutoring: TutoringConfig = dataclasses.field(default_factory=TutoringConfig)
@@ -284,6 +339,9 @@ class AppConfig:
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     sim: SimConfig = dataclasses.field(default_factory=SimConfig)
     tracing: TracingConfig = dataclasses.field(default_factory=TracingConfig)
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
 
     @property
     def client_servers(self) -> List[str]:
@@ -306,7 +364,8 @@ def load_config(path: str) -> AppConfig:
     with open(path, "rb") as fh:
         raw = tomllib.load(fh)
     unknown = set(raw) - {"cluster", "tutoring", "sampling", "gate",
-                          "resilience", "storage", "sim", "tracing"}
+                          "resilience", "storage", "sim", "tracing",
+                          "telemetry"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -330,6 +389,8 @@ def load_config(path: str) -> AppConfig:
         sim=_build(SimConfig, dict(raw.get("sim", {})), "sim"),
         tracing=_build(TracingConfig, dict(raw.get("tracing", {})),
                        "tracing"),
+        telemetry=_build(TelemetryConfig, dict(raw.get("telemetry", {})),
+                         "telemetry"),
     )
 
 

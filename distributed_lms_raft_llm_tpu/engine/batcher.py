@@ -26,7 +26,8 @@ import asyncio
 import dataclasses
 import logging
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from ..utils import metrics_registry as metric
 from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
@@ -181,6 +182,13 @@ class BatchingQueue:
             group = self._drop_expired(await self._collect())
             if not group:
                 continue  # everything expired while queued: zero prefills
+            if self.metrics is not None:
+                # Admission pressure at dispatch time: what is STILL
+                # waiting once this group leaves the queue (the telemetry
+                # timeline turns the sampled series into a saturation
+                # signal for the capacity model).
+                self.metrics.set_gauge("serving_queue_depth",
+                                       float(self.waiting))
             prompts = [p for p, _, _, _, _ in group]
             # Dispatch moment: queue.wait ends, engine.batch begins, for
             # every request of the group (per-request spans under each
@@ -327,6 +335,12 @@ class PagedQueue:
         # prefix_cache_hit_rate gauge (same run-ratio shape).
         self._prefix_hit_cum = 0                     # guarded-by: event-loop
         self._prefix_prompt_cum = 0                  # guarded-by: event-loop
+        # Recent (monotonic time, emitted tokens) reaps feeding the
+        # serving_tokens_per_s utilization gauge — a sliding few-second
+        # window, not a run ratio, so the gauge tracks the CURRENT load
+        # the capacity model bins against.
+        self._tok_window: Deque[Tuple[float, int]] = deque()  # guarded-by: event-loop
+        self._tok_window_s = 5.0
         self._runner: Optional[asyncio.Task] = None  # guarded-by: event-loop
         self._closed = False                         # guarded-by: event-loop
 
@@ -492,6 +506,8 @@ class PagedQueue:
                     mk = getattr(self.engine, "megastep_k", None)
                     if mk is not None:
                         self.metrics.set_gauge("megastep_k", float(mk))
+                    self.metrics.set_gauge("serving_queue_depth",
+                                           float(self.waiting))
                     pop_ds = getattr(self.engine, "pop_dispatch_stats",
                                      None)
                     if pop_ds is not None:
@@ -506,6 +522,17 @@ class PagedQueue:
                             self.metrics.set_gauge(
                                 "host_dispatches_per_token",
                                 self._dispatch_cum / self._token_cum,
+                            )
+                        now = time.monotonic()
+                        self._tok_window.append((now, tokens))
+                        cutoff = now - self._tok_window_s
+                        while self._tok_window[0][0] < cutoff:
+                            self._tok_window.popleft()
+                        span = now - self._tok_window[0][0]
+                        if span > 0.2:
+                            self.metrics.set_gauge(
+                                "serving_tokens_per_s",
+                                sum(n for _, n in self._tok_window) / span,
                             )
                     prefix = getattr(self.engine, "pop_prefix_stats",
                                      lambda: None)()

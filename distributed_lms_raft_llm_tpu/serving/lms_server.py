@@ -40,6 +40,11 @@ from ..utils.faults import CampaignRunner, FaultInjector
 from ..utils.guards import make_serving_watchdog
 from ..utils.metrics import Metrics
 from ..utils.resilience import CircuitBreaker
+from ..utils.timeline import (
+    Timeline,
+    TimelineSampler,
+    timeline_admin_get,
+)
 from ..utils.tracing import trace_admin_get
 
 log = logging.getLogger("lms_server")
@@ -68,11 +73,14 @@ def fault_state(faults: FaultInjector, disk_faults: DiskFaultInjector,
 
 
 def make_admin(lms_node: LMSNode, faults: FaultInjector,
-               disk_faults: DiskFaultInjector, campaigns: CampaignRunner):
+               disk_faults: DiskFaultInjector, campaigns: CampaignRunner,
+               timeline: "Timeline | None" = None):
     """The node's admin plane: (POST handler, GET handler) for the local
     HTTP endpoint (utils/healthz.py). Module-level (not inlined in
     serve_async) so the in-process semester-sim cluster (sim/cluster.py)
-    serves the EXACT operator surface the production entrypoint serves."""
+    serves the EXACT operator surface the production entrypoint serves.
+    `timeline` is the node's telemetry ring (utils/timeline.py), served
+    read-only at GET /admin/timeline."""
 
     async def admin(path: str, body: Dict) -> Dict:
         """POST /admin/membership {"op": "add"|"remove", "id": N,
@@ -163,9 +171,14 @@ def make_admin(lms_node: LMSNode, faults: FaultInjector,
         but never assert what was currently injected.
         GET /admin/trace — the flight recorder's pinned exemplars plus
         recent traces; GET /admin/trace/<request-id> — the assembled span
-        forest for one request (utils/tracing.py)."""
+        forest for one request (utils/tracing.py).
+        GET /admin/timeline — this node's telemetry ring (counter rates,
+        gauges, histogram percentiles over time + recorded events;
+        utils/timeline.py)."""
         if path.startswith("/admin/trace"):
             return trace_admin_get(path)
+        if path == "/admin/timeline":
+            return timeline_admin_get(path, timeline)
         if path != "/admin/faults":
             raise KeyError(path)
         return fault_state(faults, disk_faults, campaigns)
@@ -300,7 +313,19 @@ async def serve_async(args) -> None:
     await server.start()
     await lms_node.start()
     campaigns = CampaignRunner(faults, disk_faults, metrics=metrics)
-    admin, admin_get = make_admin(lms_node, faults, disk_faults, campaigns)
+    # Node-local telemetry timeline: a sampler thread folds /metrics
+    # snapshots into a bounded ring, served at GET /admin/timeline and
+    # merged cluster-wide by scripts/telemetry.py.
+    sampler = None
+    if args.telemetry:
+        sampler = TimelineSampler(
+            metrics, interval_s=args.telemetry_interval,
+            max_points=args.telemetry_ring,
+        ).start()
+    admin, admin_get = make_admin(
+        lms_node, faults, disk_faults, campaigns,
+        timeline=sampler.timeline if sampler is not None else None,
+    )
 
     health = None
     if args.metrics_port is not None:
@@ -337,6 +362,8 @@ async def serve_async(args) -> None:
         reporter.cancel()
         watchdog.cancel()
         campaigns.cancel()
+        if sampler is not None:
+            sampler.stop()
         if health is not None:
             await health.stop()
         await lms_node.stop()
@@ -379,6 +406,15 @@ def main(argv=None) -> None:
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
                              "ephemeral); omit to disable")
+    parser.add_argument("--no-telemetry", action="store_true",
+                        help="disable the node-local telemetry timeline "
+                             "(sampler thread + GET /admin/timeline)")
+    parser.add_argument("--telemetry-interval", type=float, default=1.0,
+                        help="telemetry timeline sample interval in "
+                             "seconds")
+    parser.add_argument("--telemetry-ring", type=int, default=600,
+                        help="telemetry timeline ring length (samples "
+                             "retained per node)")
     parser.add_argument("--breaker-threshold", type=int, default=5,
                         help="consecutive tutoring failures that open the "
                              "circuit (degraded instructor-queue answers)")
@@ -431,6 +467,7 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     args.linearizable_reads = not args.no_linearizable_reads
     args.storage_checksums = not args.storage_no_checksums
+    args.telemetry = not args.no_telemetry
     if args.config:
         from ..config import apply_file_defaults, load_config
 
@@ -468,7 +505,13 @@ def main(argv=None) -> None:
             "fault_seed": cfg.resilience.fault_seed,
             "storage_fsync": cfg.storage.fsync,
             "storage_recovery": cfg.storage.recovery,
+            "telemetry_interval": cfg.telemetry.sample_interval_s,
+            "telemetry_ring": cfg.telemetry.ring_points,
         }, argv=argv)
+        if not args.no_telemetry:
+            # Negative flag can't carry the file value through the
+            # sentinel probe; mirror the linearizable_reads merge.
+            args.telemetry = cfg.telemetry.enabled
         if not args.no_linearizable_reads:
             args.linearizable_reads = cfg.cluster.linearizable_reads
         if not args.storage_no_checksums:

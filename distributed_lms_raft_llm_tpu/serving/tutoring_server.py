@@ -39,6 +39,7 @@ from ..utils import auth
 from ..utils.guards import make_serving_watchdog
 from ..utils.metrics import Metrics
 from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
+from ..utils.timeline import TimelineSampler, timeline_admin_get
 from ..utils.tracing import get_tracer, trace_admin_get, traced_grpc_handler
 
 log = logging.getLogger("tutoring_server")
@@ -128,6 +129,9 @@ async def serve_async(
     metrics_period_s: float = 60.0,
     auth_key: Optional[str] = None,
     metrics_port: Optional[int] = None,
+    telemetry: bool = True,
+    telemetry_interval_s: float = 1.0,
+    telemetry_ring: int = 600,
 ) -> grpc.aio.Server:
     """Start (and return) the aio server; caller awaits termination.
 
@@ -171,13 +175,41 @@ async def serve_async(
     )
     server._queue = queue
     server._health = None
+    # Node-local telemetry timeline (serving tok/s, queue depth, TTFT
+    # percentiles over time), served at GET /admin/timeline; the cluster
+    # aggregator (scripts/telemetry.py) merges it with the LMS nodes'.
+    server._telemetry_sampler = None
+    if telemetry:
+        server._telemetry_sampler = TimelineSampler(
+            metrics, interval_s=telemetry_interval_s,
+            max_points=telemetry_ring,
+        ).start()
+        # The sampler is a thread, not a loop task: it outlives the
+        # event loop unless stopped. Piggyback on server.stop() so every
+        # existing caller (tests included) tears it down without a new
+        # contract item.
+        _grpc_stop = server.stop
+
+        async def _stop_with_sampler(grace):
+            if server._telemetry_sampler is not None:
+                server._telemetry_sampler.stop()
+            return await _grpc_stop(grace)
+
+        server.stop = _stop_with_sampler
     if metrics_port is not None:
         from ..utils.healthz import HealthServer
+
+        sampler = server._telemetry_sampler
 
         async def admin_get(path: str) -> dict:
             # GET /admin/trace[/id]: this node's flight-recorder fragments
             # (engine spans live HERE; trace_report merges them with the
             # LMS nodes' fragments into one waterfall).
+            # GET /admin/timeline: the telemetry ring.
+            if path == "/admin/timeline":
+                return timeline_admin_get(
+                    path, sampler.timeline if sampler is not None else None
+                )
             return trace_admin_get(path)
 
         server._health = HealthServer(
@@ -290,6 +322,15 @@ def main(argv=None) -> None:
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
                              "ephemeral); omit to disable")
+    parser.add_argument("--no-telemetry", action="store_true",
+                        help="disable the node-local telemetry timeline "
+                             "(sampler thread + GET /admin/timeline)")
+    parser.add_argument("--telemetry-interval", type=float, default=1.0,
+                        help="telemetry timeline sample interval in "
+                             "seconds")
+    parser.add_argument("--telemetry-ring", type=int, default=600,
+                        help="telemetry timeline ring length (samples "
+                             "retained)")
     parser.add_argument("--no-warmup", action="store_true")
     parser.add_argument(
         "--strict-dispatch", action="store_true",
@@ -308,6 +349,7 @@ def main(argv=None) -> None:
         help="'cpu' for CPU-only runs (tests/dev); default uses the TPU",
     )
     args = parser.parse_args(argv)
+    args.telemetry = not args.no_telemetry
     if args.config:
         from ..config import apply_file_defaults, load_config
 
@@ -331,7 +373,11 @@ def main(argv=None) -> None:
             "kv_quant": t.kv_quant, "paged": t.paged,
             "approx_topk": s.approx_top_k,
             "spec_tokens": t.spec_tokens,
+            "telemetry_interval": cfg.telemetry.sample_interval_s,
+            "telemetry_ring": cfg.telemetry.ring_points,
         }, argv=argv)
+        if not args.no_telemetry:
+            args.telemetry = cfg.telemetry.enabled
         args.sampling_overrides = dict(
             temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
             repetition_penalty=s.repetition_penalty,
@@ -418,6 +464,9 @@ def main(argv=None) -> None:
             max_wait_ms=args.max_wait_ms, max_queue=args.queue_depth,
             auth_key=auth_key,
             metrics_port=args.metrics_port,
+            telemetry=args.telemetry,
+            telemetry_interval_s=args.telemetry_interval,
+            telemetry_ring=args.telemetry_ring,
         )
         await server.wait_for_termination()
 

@@ -15,7 +15,9 @@ tests. This package composes them into ONE Jepsen-style scenario:
   `POST /admin/faults`);
 - `ledger`    — a client-side acked-write ledger proving zero acked-write
   loss and read-your-writes across the whole run;
-- `slo`       — end-of-run SLO assertions from `/metrics` + `/healthz`;
+- `slo`       — continuous fast/slow burn-rate SLO evaluation DURING the
+  run (alerts classified against the fault schedule) plus the end-of-run
+  assertions from `/metrics` + `/healthz`;
 - `cluster`   — the in-process cluster under test (real gRPC, real admin
   plane, restartable nodes);
 - `harness`   — `SemesterSim`, wiring it all together and emitting one
@@ -31,7 +33,7 @@ from .cluster import SimCluster
 from .events import SimEvent, plan_events
 from .harness import SemesterSim
 from .ledger import WriteLedger
-from .slo import SloReport, evaluate_slos
+from .slo import ContinuousSloEngine, SloReport, evaluate_slos
 from .workload import SimOp, WorkloadGenerator, trace_digest
 
 __all__ = [
@@ -41,6 +43,7 @@ __all__ = [
     "plan_events",
     "SemesterSim",
     "WriteLedger",
+    "ContinuousSloEngine",
     "SloReport",
     "evaluate_slos",
     "SimOp",

@@ -47,6 +47,7 @@ from ..utils.guards import make_serving_watchdog
 from ..utils.healthz import HealthServer
 from ..utils.metrics import Metrics
 from ..utils.resilience import CircuitBreaker
+from ..utils.timeline import TimelineSampler
 
 log = logging.getLogger(__name__)
 
@@ -470,8 +471,13 @@ class SimCluster:
         await server.start()
         await lms_node.start()
         campaigns = CampaignRunner(faults, disk_faults, metrics=metrics)
+        # Same node-local telemetry timeline the production entrypoint
+        # samples, served at GET /admin/timeline per node.
+        sampler = TimelineSampler(metrics, interval_s=0.5,
+                                  max_points=256).start()
         admin, admin_get = make_admin(lms_node, faults, disk_faults,
-                                      campaigns)
+                                      campaigns,
+                                      timeline=sampler.timeline)
         health = HealthServer(
             metrics,
             health=make_health(nid, lms_node, breaker, faults),
@@ -490,6 +496,7 @@ class SimCluster:
                 "faults": faults, "disk_faults": disk_faults,
                 "campaigns": campaigns, "metrics": metrics,
                 "breaker": breaker, "watchdog": watchdog,
+                "sampler": sampler,
             }
 
     async def _stop_node(self, nid: int) -> None:
@@ -499,6 +506,7 @@ class SimCluster:
             return
         rec["campaigns"].cancel()
         rec["watchdog"].cancel()
+        rec["sampler"].stop()
         await rec["health"].stop()
         await rec["lms_node"].stop()
         await rec["server"].stop(None)

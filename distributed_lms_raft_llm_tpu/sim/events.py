@@ -67,7 +67,12 @@ def plan_events(cfg: SimConfig) -> List[SimEvent]:
     rng = random.Random(cfg.seed ^ 0x5EED)
     T = cfg.duration_s
     chaos_hold = max(1.0, 0.10 * T)
-    outage_hold = max(0.8, 0.07 * T)
+    # The blackout must outlast the continuous SLO engine's fast window
+    # (max(1.0, 0.06*T)) with margin: the fast-window burn alert needs a
+    # span where the window sits fully inside the outage, plus the
+    # sustain requirement — a blackout shorter than the window can only
+    # ever produce diluted ratios.
+    outage_hold = max(1.5, 0.08 * T)
     return [
         SimEvent(
             at_s=_jitter(rng, 0.12, 0.02) * T, kind=CHAOS_CAMPAIGN,
@@ -138,6 +143,16 @@ class OperationsScheduler:
         with self._lock:
             return [o for o in self.outcomes if not o["ok"]]
 
+    def event_windows(self) -> List[tuple]:
+        """(start_s, end_s) wall intervals (offsets from workload start)
+        each event actually occupied — the continuous SLO engine
+        classifies burn-rate alerts against these: an alert inside a
+        fault phase is the system working, one outside is a false
+        alarm."""
+        with self._lock:
+            return [(o["t0_s"], o["t1_s"]) for o in self.outcomes
+                    if "t0_s" in o and "t1_s" in o]
+
     # ------------------------------------------------------------ internals
 
     def _run(self, t0: float) -> None:
@@ -146,7 +161,8 @@ class OperationsScheduler:
             if delay > 0:
                 time.sleep(delay)
             outcome = {"kind": event.kind, "at_s": round(event.at_s, 3),
-                       "ok": False, "detail": ""}
+                       "ok": False, "detail": "",
+                       "t0_s": round(time.monotonic() - t0, 3)}
             try:
                 handler = {
                     CHAOS_CAMPAIGN: self._chaos_campaign,
@@ -162,6 +178,7 @@ class OperationsScheduler:
             except Exception as e:  # recorded; the harness fails the run
                 log.exception("sim event %s failed", event.kind)
                 outcome["detail"] = f"{type(e).__name__}: {e}"
+            outcome["t1_s"] = round(time.monotonic() - t0, 3)
             with self._lock:
                 self.outcomes.append(outcome)
 

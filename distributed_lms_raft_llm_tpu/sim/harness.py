@@ -39,10 +39,13 @@ from ..utils import metrics_registry as metric
 from ..utils import pdf
 from ..utils.metrics import Metrics
 from ..utils.resilience import DeadlineExpired
+from ..utils.scrape import ClusterScraper, SourceFn, http_source
+from ..utils.timeline import snap_counter, snap_hist
 from ..utils.tracing import get_tracer
 from . import events as ev
 from . import workload as wl
 from .cluster import SimCluster
+from .slo import ContinuousSloEngine
 from .ledger import (
     ASSIGNMENT,
     GRADE,
@@ -62,6 +65,68 @@ class SimOpFailed(Exception):
 
 _CLIENT_ERRORS = (grpc.RpcError, NoLeader, DeadlineExpired, TimeoutError,
                   SimOpFailed)
+
+
+class _TelemetryLoop:
+    """The sim's in-run telemetry plane: one thread polls every node's
+    `/metrics` (plus the harness's own client-side Metrics and the
+    in-process tutoring queue) through the REAL scrape aggregator into a
+    merged cluster timeline, and runs the continuous SLO engine's
+    burn-rate evaluation on each tick. Starts at workload t0, stops
+    before settle — the settle phase's deliberate degraded probes must
+    not read as alerts."""
+
+    def __init__(self, sim: "SemesterSim", t0: float):
+        self.sim = sim
+        self.t0 = t0
+        cluster = sim.cluster
+
+        def sources() -> Dict[str, SourceFn]:
+            # Re-resolved every poll: membership events change the node
+            # set mid-run; a restarting node is simply unreachable for a
+            # round.
+            out: Dict[str, SourceFn] = {
+                f"node{nid}": http_source(
+                    f"http://127.0.0.1:{cluster.health_port(nid)}/metrics"
+                )
+                for nid in cluster.node_ids()
+            }
+            out["tutoring"] = cluster.tutoring_metrics_snapshot
+            out["sim"] = sim.metrics.snapshot
+            return out
+
+        self.scraper = ClusterScraper(sources_fn=sources)
+        self.engine = ContinuousSloEngine(
+            sim.cfg, self.scraper.cluster, sim.metrics,
+            metrics=sim.metrics,
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="sim-telemetry", daemon=True
+        )
+
+    def start(self) -> "_TelemetryLoop":
+        # Baseline poll BEFORE evaluations begin: the first sight of a
+        # source seeds its counter baselines (boot-era counts must not
+        # read as a rate spike in the first window).
+        self.scraper.poll()
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("telemetry loop did not stop")
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.sim.cfg.telemetry_sample_s):
+            try:
+                self.scraper.poll()
+                self.engine.evaluate(time.monotonic() - self.t0)
+            except Exception:
+                # Telemetry must never kill the run it observes.
+                log.exception("telemetry poll failed")
 
 
 def _password(actor: str) -> str:
@@ -111,6 +176,9 @@ class SemesterSim:
                 writer=self._bot_write, asker=self._bot_ask,
             )
             t0 = time.monotonic()
+            telemetry: Optional[_TelemetryLoop] = None
+            if self.cfg.continuous_slos:
+                telemetry = _TelemetryLoop(self, t0).start()
             threads = self._start_workers(ops, t0)
             scheduler.start(t0)
             margin = 30.0 + self.cfg.llm_budget_s
@@ -119,6 +187,12 @@ class SemesterSim:
                 if t.is_alive():
                     raise TimeoutError(f"sim worker {t.name} wedged")
             scheduler.join(self.cfg.duration_s + margin)
+            if telemetry is not None:
+                # Stop BEFORE settle: the settle phase's deliberate
+                # degraded probes are post-scenario housekeeping, not
+                # SLO evidence.
+                telemetry.stop()
+                telemetry.engine.finish(scheduler.event_windows())
             self._settle()
             self._audit()
             node_metrics, node_health = self.cluster.scrape_all()
@@ -130,9 +204,12 @@ class SemesterSim:
                 traces=traces,
                 tutoring_metrics=self.cluster.tutoring_metrics_snapshot(),
                 metrics=self.metrics,
+                continuous=(telemetry.engine.report()
+                            if telemetry is not None else None),
             )
             return self._record(ops, plan, scheduler, report, node_metrics,
-                                traces, time.monotonic() - t_start)
+                                traces, time.monotonic() - t_start,
+                                telemetry=telemetry)
         finally:
             for c in self._clients.values():
                 c.close()
@@ -489,17 +566,20 @@ class SemesterSim:
     # ---------------------------------------------------------------- record
 
     def _record(self, ops, plan, scheduler, report, node_metrics,
-                traces, wall_s: float) -> Dict:
+                traces, wall_s: float, telemetry=None) -> Dict:
         snap = self.metrics.snapshot()
         counters = snap.get("counters", {})
-        ask = snap.get("latency", {}).get("sim_ask_latency", {})
+        ask = snap_hist(snap, metric.SIM_ASK_LATENCY)
         ledger_report = self.ledger.report()
 
         def node_sum(name: str) -> int:
             # Undercounts across a rolling restart (the restarted node's
             # counters reset) — good enough for ">= 1 really happened".
-            return sum(int(s.get("counters", {}).get(name, 0))
+            return sum(snap_counter(s, name)
                        for s in node_metrics.values())
+
+        gate_pass = node_sum(metric.GATE_PASS)
+        gate_reject = node_sum(metric.GATE_REJECT)
 
         # The flight recorder's verdict attachments: exemplar digests
         # (what was pinned and why — slow, degraded, errored) and the
@@ -537,14 +617,23 @@ class SemesterSim:
             "ops_dropped": counters.get("sim_ops_dropped", 0),
             "asks": ask.get("count", 0),
             "degraded_answers": counters.get("sim_degraded_answers", 0),
-            "gate_pass": node_sum("gate_pass"),
-            "gate_reject": node_sum("gate_reject"),
+            "gate_pass": gate_pass,
+            "gate_reject": gate_reject,
             "acked_writes": ledger_report["acked_writes"],
             "events": scheduler.outcomes,
             "events_executed": scheduler.executed_kinds(),
             "slos": report.to_dict(),
             "trace_exemplars": exemplars,
             "slowest_trace": slowest,
+            # The in-run telemetry plane's artifacts: the burn-rate
+            # engine's report (also inside slos.continuous) and the full
+            # scraped timeline export — the input
+            # `scripts/telemetry.py --capacity` fits the capacity model
+            # over, embedded so one BENCH line replays the analysis.
+            "telemetry": (telemetry.engine.report()
+                          if telemetry is not None else None),
+            "timeline": (telemetry.scraper.export()
+                         if telemetry is not None else None),
             "wall_s": round(wall_s, 1),
         }
 

@@ -7,6 +7,11 @@ can scrape without a gRPC client:
 
     GET /healthz  -> {"ok": true, "role": "leader", ...}
     GET /metrics  -> the Metrics.snapshot() JSON
+    GET /metrics.prom -> the same snapshot in Prometheus text exposition
+                     (utils/timeline.render_prometheus: name/kind/help
+                     from utils/metrics_registry.py), so a stock
+                     Prometheus/VictoriaMetrics scraper ingests every
+                     node with zero glue
     POST /admin/* -> optional admin hook (e.g. cluster membership change
                      on the LMS leader: serving/lms_server.py) — JSON body
                      in, JSON out; the admin plane stays off the frozen
@@ -28,6 +33,7 @@ import json
 from typing import Awaitable, Callable, Dict, Optional
 
 from .metrics import Metrics
+from .timeline import render_prometheus
 
 Provider = Callable[[], Dict]
 # (path, body) -> response dict; raise KeyError for unknown paths,
@@ -89,10 +95,15 @@ class HealthServer:
                         content_length = max(0, int(line.split(b":", 1)[1]))
                     except ValueError:
                         pass
+            ctype = "application/json"
             if path == "/healthz":
                 body, status = json.dumps(self.health()), 200
             elif path == "/metrics":
                 body, status = json.dumps(self.metrics.snapshot()), 200
+            elif path == "/metrics.prom":
+                body = render_prometheus(self.metrics.snapshot())
+                status = 200
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif (
                 method == "GET"
                 and path.startswith("/admin/")
@@ -135,7 +146,7 @@ class HealthServer:
             writer.write(
                 (
                     f"HTTP/1.1 {status} {reason}\r\n"
-                    "Content-Type: application/json\r\n"
+                    f"Content-Type: {ctype}\r\n"
                     f"Content-Length: {len(payload)}\r\n"
                     "Connection: close\r\n\r\n"
                 ).encode()

@@ -12,25 +12,59 @@ JSON-serialized straight into logs or the bench harness.
 from __future__ import annotations
 
 import bisect
+import math
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+
+def percentile_of_sorted(samples: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted sequence: the
+    smallest sample ranked at or above p% of the distribution.
+
+    The ONE quantile index formula in the repo. `LatencyHistogram`
+    (percentile + snapshot), `sim/slo.stage_breakdown`, and the telemetry
+    timeline's windowed percentiles all share it, so small-n behavior
+    agrees everywhere: p50 of a 2-sample set is the FIRST sample
+    (ceil(0.5*2)-1 == 0), not the max — the old per-call-site `n // 2` /
+    `int(n * p / 100)` formulas disagreed exactly there.
+    """
+    n = len(samples)
+    if n == 0:
+        raise ValueError("percentile of an empty sequence")
+    idx = min(n - 1, max(0, math.ceil(n * p / 100.0) - 1))
+    return samples[idx]
 
 
 class LatencyHistogram:
-    """Reservoir of recent latencies with percentile queries."""
+    """Reservoir of recent latencies with percentile queries.
 
-    def __init__(self, max_samples: int = 4096):
+    Alongside the centered reservoir (all-time percentiles), a small
+    time-stamped ring of the most recent observations backs
+    `window_percentile` — the true sliding-window quantile the continuous
+    SLO engine (sim/slo.py) evaluates burn rates against, which a
+    cumulative reservoir cannot answer (an early spike would hold the
+    all-time p95 up forever).
+    """
+
+    def __init__(self, max_samples: int = 4096, recent: int = 1024):
         self._samples: List[float] = []  # guarded-by: _lock
         self._max = max_samples
         self._count = 0                  # guarded-by: _lock
         self._total = 0.0                # guarded-by: _lock
+        # (monotonic time, value) of the newest observations, for
+        # windowed quantiles; bounded so observe() stays O(log n).
+        self._recent: Deque[Tuple[float, float]] = deque(  # guarded-by: _lock
+            maxlen=recent
+        )
         self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:
         with self._lock:
             self._count += 1
             self._total += seconds
+            self._recent.append((time.monotonic(), seconds))
             bisect.insort(self._samples, seconds)
             if len(self._samples) > self._max:
                 # Drop alternating extremes to keep the reservoir centered.
@@ -40,8 +74,20 @@ class LatencyHistogram:
         with self._lock:
             if not self._samples:
                 return None
-            idx = min(int(len(self._samples) * p / 100.0), len(self._samples) - 1)
-            return self._samples[idx]
+            return percentile_of_sorted(self._samples, p)
+
+    def window_percentile(self, window_s: float, p: float,
+                          now: Optional[float] = None) -> Optional[float]:
+        """Percentile of the observations from the last `window_s`
+        seconds (None when the window is empty — distinct from 0.0).
+        Bounded by the recent ring: under extreme rates the window may
+        cover fewer observations than arrived, never more."""
+        cutoff = (now if now is not None else time.monotonic()) - window_s
+        with self._lock:
+            vals = sorted(v for t, v in self._recent if t >= cutoff)
+        if not vals:
+            return None
+        return percentile_of_sorted(vals, p)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -55,12 +101,12 @@ class LatencyHistogram:
                 # readers can judge how trustworthy a p95/p99 is.
                 "samples": n,
                 "mean_s": self._total / self._count,
-                "p50_s": self._samples[n // 2],
-                "p90_s": self._samples[min(int(n * 0.9), n - 1)],
+                "p50_s": percentile_of_sorted(self._samples, 50),
+                "p90_s": percentile_of_sorted(self._samples, 90),
                 # p95 is the SLO percentile the semester simulator (sim/)
                 # asserts from /metrics, so it ships in every snapshot.
-                "p95_s": self._samples[min(int(n * 0.95), n - 1)],
-                "p99_s": self._samples[min(int(n * 0.99), n - 1)],
+                "p95_s": percentile_of_sorted(self._samples, 95),
+                "p99_s": percentile_of_sorted(self._samples, 99),
                 "max_s": self._samples[-1],
             }
 

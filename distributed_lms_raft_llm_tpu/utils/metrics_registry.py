@@ -258,6 +258,20 @@ PREFIX_CACHE_HIT_RATE = gauge(
     "cumulative fraction of admitted prompt tokens served from the "
     "shared-prefix cache (hit tokens / prompt tokens since queue start)",
 )
+SERVING_TOKENS_PER_S = gauge(
+    "serving_tokens_per_s",
+    "recent serving throughput on the paged engine: emitted tokens per "
+    "second over the last few seconds of reaps — the utilization "
+    "numerator the capacity model divides by the chip's saturation "
+    "ceiling (BENCH_NOTES: ~61.5k tok/s int8 at batch 128+)",
+)
+SERVING_QUEUE_DEPTH = gauge(
+    "serving_queue_depth",
+    "requests admitted but not yet in a device batch (the bound "
+    "`max_queue` is enforced against), sampled at each scheduling "
+    "round — queue growth at flat tokens/s is the saturation signal "
+    "the capacity model and autoscaler watch",
+)
 
 # Per-program engine dispatch wall time (host-side: the time the serving
 # loop spends issuing each compiled program; device compute overlaps it
@@ -397,6 +411,12 @@ SIM_ACKED_WRITE_LOSSES = counter(
 )
 SIM_SLO_VIOLATIONS = counter(
     "sim_slo_violations", "semester-sim SLO checks that failed"
+)
+SIM_BURN_ALERTS = counter(
+    "sim_burn_alerts",
+    "burn-rate alerts the continuous SLO engine raised during the run "
+    "(fast- and slow-window; each is also recorded as a timeline event "
+    "and classified against the injected-fault phases in the verdict)",
 )
 
 # Raft runner (utils/guards.py LoopWatchdog wired by lms/node.py).

@@ -20,6 +20,12 @@ them:
     # BENCH record's embedded `slowest_trace`) instead of an endpoint.
     python scripts/trace_report.py --json trace.json <request-id>
 
+    # Regression triage: side-by-side per-stage p95 diff of two runs.
+    # Each file is a stage_p95s export — a semester-sim BENCH record
+    # (slos.stage_p95s), an SLO verdict, a saved trace (the breakdown is
+    # computed from its spans), or a bare {stage: {p95_s, ...}} mapping:
+    python scripts/trace_report.py --diff before.json after.json
+
 The waterfall is wall-clock aligned: fragments recorded by different
 processes line up by their absolute start times, so cross-process clock
 skew shows up as (small) overlap rather than being hidden.
@@ -100,6 +106,67 @@ def render_waterfall(trace: Dict[str, Any], out=None) -> None:
         )
 
 
+def load_stage_p95s(path: str) -> Dict[str, Dict[str, float]]:
+    """Per-stage stats from any artifact this repo emits: a BENCH record
+    (slos.stage_p95s), an SLO verdict (stage_p95s), a saved trace doc
+    (breakdown computed from its spans), or the bare mapping itself."""
+    from distributed_lms_raft_llm_tpu.sim.slo import stage_breakdown
+
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict):
+        raise SystemExit(f"{path}: expected a JSON object")
+    slos = doc.get("slos")
+    if isinstance(slos, dict) and isinstance(slos.get("stage_p95s"), dict):
+        return slos["stage_p95s"]
+    if isinstance(doc.get("stage_p95s"), dict):
+        return doc["stage_p95s"]
+    tree = doc.get("trace", doc)
+    if isinstance(tree, dict) and isinstance(tree.get("spans"), list):
+        return stage_breakdown([tree])
+    # A bare mapping: every value must look like a stats block.
+    if doc and all(isinstance(v, dict) for v in doc.values()):
+        return {k: {kk: float(vv) for kk, vv in v.items()}
+                for k, v in doc.items()}
+    raise SystemExit(f"{path}: no stage_p95s / spans found")
+
+
+def render_stage_diff(a: Dict[str, Dict[str, float]],
+                      b: Dict[str, Dict[str, float]],
+                      label_a: str, label_b: str, out=None) -> None:
+    """Side-by-side per-stage waterfall diff: where run B's latency
+    budget moved relative to run A, worst p95 regression first — the
+    round-6 measurement campaign's triage view."""
+    out = out if out is not None else sys.stdout
+    stages = sorted(
+        set(a) | set(b),
+        key=lambda s: -abs(b.get(s, {}).get("p95_s", 0.0)
+                           - a.get(s, {}).get("p95_s", 0.0)),
+    )
+    name_w = max([len(s) for s in stages] + [5])
+    out.write(
+        f"  {'stage':<{name_w}} {'A p95':>10} {'B p95':>10} "
+        f"{'delta':>10} {'pct':>8}   A={label_a}  B={label_b}\n"
+    )
+    for stage in stages:
+        pa = a.get(stage, {}).get("p95_s")
+        pb = b.get(stage, {}).get("p95_s")
+        cell_a = f"{pa * 1e3:8.1f}ms" if pa is not None else "       -"
+        cell_b = f"{pb * 1e3:8.1f}ms" if pb is not None else "       -"
+        if pa is not None and pb is not None:
+            delta = pb - pa
+            pct = (f"{delta / pa * 100:+7.1f}%" if pa > 0 else "      -")
+            cell_d = f"{delta * 1e3:+8.1f}ms"
+        else:
+            # A stage only one run has IS the finding (a new stage
+            # appeared, or one vanished) — keep it visible, not dropped.
+            cell_d, pct = "     new" if pa is None else "    gone", "      -"
+        out.write(
+            f"  {stage:<{name_w}} {cell_a:>10} {cell_b:>10} "
+            f"{cell_d:>10} {pct:>8}\n"
+        )
+
+
 def render_summaries(listing: Dict[str, Any], source: str,
                      out=None) -> None:
     out = out if out is not None else sys.stdout
@@ -132,8 +199,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="append", default=[], dest="json_files",
                     help="saved /admin/trace/<id> response (or embedded "
                          "slowest_trace) to merge; repeatable")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="side-by-side per-stage p95 diff of two "
+                         "stage_p95s exports (BENCH records, SLO "
+                         "verdicts, saved traces, or bare mappings)")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
+    if args.diff:
+        a, b = args.diff
+        render_stage_diff(load_stage_p95s(a), load_stage_p95s(b),
+                          os.path.basename(a), os.path.basename(b))
+        return 0
     if not args.endpoint and not args.json_files:
         ap.error("need at least one --endpoint or --json")
 

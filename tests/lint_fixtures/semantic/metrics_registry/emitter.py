@@ -48,3 +48,46 @@ class Server:
 
     def via_wrapper_typo(self):
         self._inc("bad_series")  # EXPECT: metrics-registry
+
+    # Snapshot/timeline READ sites are checked like emissions (an SLO
+    # bound on a never-declared series would read 0 forever) but never
+    # count as emissions themselves.
+
+    def read_declared(self, snap):
+        return snap_counter(snap, "good_series")  # fine: declared read
+
+    def read_typo(self, snap):
+        return snap_gauge(snap, "state_seeries")  # EXPECT: metrics-registry
+
+    def read_window_typo(self, timeline):
+        return timeline.hist_p95("mystery_latency", 30.0)  # EXPECT: metrics-registry
+
+    def read_dynamic(self, snap, which):
+        return snap_counter(snap, "prefix_" + which)  # EXPECT: metrics-registry
+
+    def read_registry_rooted(self, timeline):
+        return timeline.counter_rate(metrics_registry.GOOD, 60.0)  # fine
+
+    def _node_sum(self, name, snaps):
+        # Read-forwarding seam (first non-self parameter flows into a
+        # reader's name slot): call sites are checked, not this line,
+        # and the forwarded names never count as emitted.
+        return sum(snap_counter(s, name) for s in snaps)
+
+    def via_read_wrapper_ok(self, snaps):
+        return self._node_sum("good_series", snaps)  # fine: declared
+
+    def via_read_wrapper_typo(self, snaps):
+        return self._node_sum("goood_series", snaps)  # EXPECT: metrics-registry
+
+
+def snap_counter(snap, name):
+    # Stand-in for utils/timeline.snap_counter: the rule matches readers
+    # by NAME, so the helper living here keeps the mini-project
+    # self-contained. The dict access below is not a reader call, so
+    # nothing in this body is checked.
+    return snap.get("counters", {}).get(name, 0)
+
+
+def snap_gauge(snap, name):
+    return snap.get("gauges", {}).get(name, 0.0)

@@ -165,6 +165,44 @@ def test_unregistered_metric_name_fails_lint():
     assert findings, "a typo'd metric name must fail metrics-registry"
 
 
+SLO = "distributed_lms_raft_llm_tpu/sim/slo.py"
+
+
+def test_slo_read_of_undeclared_series_fails_lint():
+    """PR-11 acceptance pin: SLO bounds read metric names through the
+    registry constants + shared snapshot readers, and the
+    metrics-registry rule checks the READ sites — reverting a constant
+    back to a (typo'd) literal makes the bound silently read 0 forever,
+    and must fail lint."""
+    project = _project_with_patch(SLO, (
+        "snap_counter(s, metric.TUTORING_DEGRADED)",
+        'snap_counter(s, "tutoring_degarded")',
+    ))
+    findings = [
+        f for f in MetricsRegistryRule().check_project(project)
+        if f.path == SLO and "tutoring_degarded" in f.message
+    ]
+    assert findings, "an SLO read of an undeclared series must fail " \
+        "metrics-registry"
+
+
+def test_slo_windowed_read_of_undeclared_series_fails_lint():
+    """Same class at the timeline window queries: a burn-rate evaluator
+    bound to a never-declared series must fail lint."""
+    project = _project_with_patch(SLO, (
+        "self.cluster.counter_rate(metric.RAFT_TICK_STALLS,\n"
+        "                                             window_s, now)",
+        'self.cluster.counter_rate("raft_tick_stals",\n'
+        "                                             window_s, now)",
+    ))
+    findings = [
+        f for f in MetricsRegistryRule().check_project(project)
+        if f.path == SLO and "raft_tick_stals" in f.message
+    ]
+    assert findings, "a windowed read of an undeclared series must fail " \
+        "metrics-registry"
+
+
 # ------------------------------------------- reversion pins (absint, PR 6)
 
 

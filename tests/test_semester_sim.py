@@ -11,6 +11,7 @@ guard keeps the tier-1 run inside its time box.
 
 import dataclasses
 import time
+from pathlib import Path
 
 import pytest
 
@@ -83,6 +84,65 @@ def test_sim_exercised_degraded_path(sim_run):
     record, _ = sim_run
     assert record["degraded_answers"] >= 1
     assert record["asks"] > 10
+
+
+def test_continuous_slo_engine_evaluated_and_alerted(sim_run):
+    """PR-11 acceptance: the SLOs are evaluated in burn-rate windows
+    DURING the run — >= 1 window evaluated per SLO, zero false alarms on
+    the healthy baseline, and the injected tutoring blackout raises
+    (then clears) at least one fast-window alert, recorded as timeline
+    events and classified against the fault schedule."""
+    record, _ = sim_run
+    cont = record["slos"]["continuous"]
+    assert cont is not None and cont["enabled"]
+    for slo in ("answer_p95", "degraded_rate", "tick_stalls"):
+        assert cont["windows_evaluated"].get(slo, 0) >= 1, slo
+    checks = record["slos"]["checks"]
+    assert checks["burn_windows_evaluated"]["ok"]
+    assert checks["no_false_alarms"]["ok"], checks["no_false_alarms"]
+    fast = [a for a in cont["alerts"]
+            if a["window"] == "fast" and a["during_fault"]]
+    assert fast, f"blackout raised no fast-window alert: {cont['alerts']}"
+    assert any(a["cleared_at_s"] is not None for a in fast), (
+        "the fast alert must clear once the fault passes"
+    )
+    # Alerts double as timeline events in the exported cluster timeline.
+    kinds = [e["kind"] for e in record["timeline"]["cluster"]["events"]]
+    assert "slo_alert_raised" in kinds and "slo_alert_cleared" in kinds
+
+
+def test_timeline_export_feeds_capacity_model(sim_run):
+    """PR-11 acceptance: the run's exported timeline + stage p95s feed
+    `scripts/telemetry.py --capacity`, which emits the capacity-model
+    JSON (req/s-per-node-at-SLO) the router and autoscaler consume."""
+    import importlib.util
+    import json
+
+    record, _ = sim_run
+    timeline = record["timeline"]
+    assert timeline and len(timeline["cluster"]["points"]) >= 10
+    assert "tutoring" in timeline["nodes"]
+    spec = importlib.util.spec_from_file_location(
+        "telemetry", str(Path(__file__).resolve().parent.parent
+                         / "scripts" / "telemetry.py")
+    )
+    telemetry = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(telemetry)
+    model = telemetry.fit_capacity(
+        json.loads(json.dumps(record)),  # as the CLI would read it
+        slo_p95_s=TIER1_CFG.slo_answer_p95_s,
+        ceiling_tokens_per_s=61500.0,
+    )
+    assert model["metric"] == "capacity_req_s_per_node_at_slo"
+    assert model["unit"] == "req/s/node"
+    assert model["value"] > 0, "the sim served real load at SLO"
+    assert model["samples"] >= 5
+    # The echo engine never saturates the SLO in 16 s: the fit must say
+    # so (lower bound), not fabricate a knee.
+    assert model["slo_saturated"] is False
+    assert model["service_time_p95_s"] is not None, (
+        "flight-recorder stage p95s fold into the model"
+    )
 
 
 def test_sim_exercised_relevance_gate(sim_run):
