@@ -139,7 +139,9 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
                 megastep_max: int = 0, inflight: int = 2,
                 max_new: int = MAX_NEW, rounds: int = ROUNDS,
                 prompt_len: int = PROMPT_LEN,
-                length_buckets=None, prefix_cache_blocks: int = 0) -> dict:
+                length_buckets=None, prefix_cache_blocks: int = 0,
+                prefill_chunk_tokens: int = 0,
+                draft_source: str = "prompt_lookup") -> dict:
     """Continuous-batching throughput/TTFT through PagedEngine directly.
 
     Same shape of numbers as bench_tpu so paged and paged+spec enter the
@@ -179,6 +181,7 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
             quant="int8" if quant else None,
             kv_quant=quant,
             spec_tokens=spec_tokens,
+            draft_source=draft_source,
             **artifacts,
         ),
         slots=batch,
@@ -188,6 +191,7 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
         megastep_max=megastep_max,
         prefix_cache=prefix_cache_blocks > 0,
         prefix_cache_blocks=max(1, prefix_cache_blocks),
+        prefill_chunk_tokens=prefill_chunk_tokens,
     )
     rng = np.random.default_rng(0)
     prompts = [
@@ -208,7 +212,8 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
     elapsed = time.monotonic() - t0
     tps = engine.total_generated_tokens / elapsed
     spec_stats = engine.pop_spec_stats()
-    dispatches, emitted, dead_lanes = engine.pop_dispatch_stats()
+    (dispatches, emitted, dead_lanes, stall_ms,
+     stalled_tokens) = engine.pop_dispatch_stats()
     engine.pop_ttfts()
 
     # Idle-engine TTFT (same protocol as bench_tpu: median of 7 batch-1
@@ -234,6 +239,12 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
             dispatches / emitted if emitted else None
         ),
         "megastep_dead_lane_tokens": dead_lanes,
+        # Stall-free admission before/after: decode-train pause charged
+        # to sequential admission (0 by construction when
+        # prefill_chunk_tokens > 0 stages admissions into the scan).
+        "prefill_chunk_tokens": prefill_chunk_tokens,
+        "prefill_stall_ms": round(stall_ms, 2),
+        "decode_stalled_tokens": stalled_tokens,
         "platform": jax.devices()[0].platform,
     }
     if spec_stats is not None:
@@ -382,6 +393,60 @@ def bench_shared_prefix(model: str = "gpt2", tp: int = 1,
     }
 
 
+def bench_sweep(model: str = "gpt2", tp: int = 1, quant: bool = False,
+                slots_grid=(16, 32, 64), inflight_grid=(2, 3, 4),
+                megastep_grid=(1, 4, 8), spec_tokens: int = 0,
+                greedy: bool = False, chunk: int = 16,
+                max_new: int = MAX_NEW, rounds: int = 2,
+                prompt_len: int = PROMPT_LEN, length_buckets=None,
+                prefix_cache_blocks: int = 0,
+                prefill_chunk_tokens: int = 0,
+                draft_source: str = "prompt_lookup") -> list:
+    """Round-6 grid: slots x inflight-depth x megastep rungs, one
+    BENCH-schema record per point.
+
+    Each point is an independent `bench_paged` run (fresh engine, same
+    seeded workload scaled to the slot count), so a sweep answers the
+    ROADMAP's open questions — slot counts beyond 16, inflight-depth,
+    and megastep ladders — in one command whose output is `jq`-able
+    straight into BENCH_NOTES. `rounds` defaults low (2) because a sweep
+    multiplies runs; raise it for tighter chip numbers. CPU-smoked in
+    tests/test_bench_record.py so the grid path cannot rot between chip
+    attachments."""
+    records = []
+    for slots in slots_grid:
+        for inflight in inflight_grid:
+            for mega in megastep_grid:
+                out = bench_paged(
+                    model=model, tp=tp, quant=quant, batch=slots,
+                    spec_tokens=spec_tokens, greedy=greedy, chunk=chunk,
+                    megastep=mega, megastep_max=mega, inflight=inflight,
+                    max_new=max_new, rounds=rounds,
+                    prompt_len=prompt_len, length_buckets=length_buckets,
+                    prefix_cache_blocks=prefix_cache_blocks,
+                    prefill_chunk_tokens=prefill_chunk_tokens,
+                    draft_source=draft_source,
+                )
+                records.append({
+                    "metric": (
+                        f"paged_sweep_slots{slots}_inflight{inflight}"
+                        f"_mega{mega}"
+                    ),
+                    "value": round(out["tokens_per_sec_per_chip"], 2),
+                    "unit": "tokens/sec/chip",
+                    "slots": slots,
+                    **{k: out[k] for k in (
+                        "requests_per_s", "ttft_p50_ms", "chunk",
+                        "megastep", "megastep_max", "inflight",
+                        "host_dispatches_per_token",
+                        "megastep_dead_lane_tokens",
+                        "prefill_chunk_tokens", "prefill_stall_ms",
+                        "decode_stalled_tokens", "platform",
+                    )},
+                })
+    return records
+
+
 def bench_torch_baseline(model: str = "gpt2", budget_new_tokens: int = 32) -> float:
     """Reference path: torch-CPU GPT-2 (matching size), sequential queries."""
     arch = {
@@ -463,6 +528,32 @@ def main() -> None:
                     help="paged: enable the radix shared-prefix KV cache "
                          "with this block budget (0 = off); the record "
                          "carries the measured hit rate")
+    ap.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                    help="paged: fused stall-free admission — stage "
+                         "prompts and prefill this many tokens per "
+                         "megastep scan iteration inside the decode "
+                         "program (0 = sequential admission; the record "
+                         "carries prefill_stall_ms/decode_stalled_tokens)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="paged: run the round-6 grid (slots x inflight "
+                         "x megastep rungs) and print one BENCH-schema "
+                         "JSON line per point instead of the single "
+                         "headline record")
+    ap.add_argument("--sweep-slots", default="16,32,64",
+                    help="comma-separated slot counts for --sweep")
+    ap.add_argument("--sweep-inflight", default="2,3,4",
+                    help="comma-separated inflight depths for --sweep")
+    ap.add_argument("--sweep-megasteps", default="1,4,8",
+                    help="comma-separated megastep rungs for --sweep")
+    ap.add_argument("--sweep-rounds", type=int, default=2,
+                    help="request rounds per sweep grid point (2 keeps a "
+                         "full grid cheap; raise for tighter chip numbers)")
+    ap.add_argument("--draft-source", default="prompt_lookup",
+                    choices=["prompt_lookup", "ngram"],
+                    help="paged+spec draft source: prompt_lookup = "
+                         "most-recent n-gram continuation; ngram = per-slot "
+                         "modal-continuation table (higher acceptance at "
+                         "temperature>0)")
     ap.add_argument("--prefix-scenario", action="store_true",
                     help="paged: also run the shared-prefix scenario (N "
                          "requests against one common course context, "
@@ -481,13 +572,35 @@ def main() -> None:
         if args.tp == 1:
             args.tp = t.tp
     extra = dict(spec_tokens=args.spec_tokens, greedy=args.greedy)
+    if args.sweep:
+        grid = bench_sweep(
+            args.model, args.tp, quant=args.tp == 1,
+            slots_grid=tuple(int(s) for s in args.sweep_slots.split(",")),
+            inflight_grid=tuple(
+                int(s) for s in args.sweep_inflight.split(",")
+            ),
+            megastep_grid=tuple(
+                int(s) for s in args.sweep_megasteps.split(",")
+            ),
+            chunk=args.chunk,
+            rounds=args.sweep_rounds,
+            prefix_cache_blocks=args.prefix_cache_blocks,
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
+            draft_source=args.draft_source,
+            **extra,
+        )
+        for record in grid:
+            print(json.dumps(record))
+        return
     run = bench_tpu
     if args.paged:
         run = partial(bench_paged, chunk=args.chunk,
                       megastep=args.megastep,
                       megastep_max=args.megastep_max,
                       inflight=args.inflight,
-                      prefix_cache_blocks=args.prefix_cache_blocks)
+                      prefix_cache_blocks=args.prefix_cache_blocks,
+                      prefill_chunk_tokens=args.prefill_chunk_tokens,
+                      draft_source=args.draft_source)
     quant = (run(args.model, args.tp, quant=True, batch=args.batch, **extra)
              if args.tp == 1 else None)
     tpu = run(args.model, args.tp, batch=args.batch, **extra)
@@ -499,6 +612,8 @@ def main() -> None:
         name += "_paged"
     if args.paged and args.megastep > 1:
         name += f"_mega{args.megastep}"
+    if args.paged and args.prefill_chunk_tokens:
+        name += f"_fusedadm{args.prefill_chunk_tokens}"
     if args.greedy:
         name += "_greedy"
     if args.spec_tokens:
@@ -534,6 +649,9 @@ def main() -> None:
         record["megastep_dead_lane_tokens"] = (
             head["megastep_dead_lane_tokens"]
         )
+        record["prefill_chunk_tokens"] = head["prefill_chunk_tokens"]
+        record["prefill_stall_ms"] = head["prefill_stall_ms"]
+        record["decode_stalled_tokens"] = head["decode_stalled_tokens"]
     if head.get("spec_tokens_per_window") is not None:
         record["spec_tokens_per_window"] = round(
             head["spec_tokens_per_window"], 2

@@ -103,6 +103,21 @@ class TutoringConfig:
     #                              shared-prefix tree (16 tokens/block);
     #                              ref-count-pinned blocks are never
     #                              evicted, LRU leaves go first
+    prefill_chunk_tokens: int = 0  # paged: fused stall-free admission —
+    #                              stage arriving prompts into SlotState
+    #                              and prefill this many tokens per
+    #                              megastep scan iteration INSIDE the
+    #                              decode program, instead of pausing
+    #                              the decode train for a standalone
+    #                              prefill dispatch. 0 = sequential
+    #                              admission. Admission latency becomes
+    #                              bounded by scan iterations (~chunk
+    #                              device steps each), not prompt length
+    draft_source: str = "prompt_lookup"  # paged+spec: "prompt_lookup"
+    #                              (most-recent n-gram continuation) or
+    #                              "ngram" (per-slot modal-continuation
+    #                              table — higher acceptance at
+    #                              temperature>0)
     auth_key_file: Optional[str] = None
 
     @property
@@ -469,6 +484,7 @@ def engine_config(cfg: AppConfig):
         merges_path=t.merges, tokenizer_json=t.tokenizer_json,
         sampling=sampling_params(cfg), tp=t.tp, ep=t.ep, quant=t.quant,
         kv_quant=t.kv_quant, spec_tokens=t.spec_tokens,
+        draft_source=t.draft_source,
     )
 
 

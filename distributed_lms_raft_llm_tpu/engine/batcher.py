@@ -511,10 +511,21 @@ class PagedQueue:
                     pop_ds = getattr(self.engine, "pop_dispatch_stats",
                                      None)
                     if pop_ds is not None:
-                        dispatches, tokens, dead = pop_ds()
+                        (dispatches, tokens, dead, stall_ms,
+                         stalled) = pop_ds()
                         if dead:
                             self.metrics.inc(
                                 "megastep_dead_lane_tokens", dead
+                            )
+                        if stall_ms:
+                            # Decode-train pause attributable to
+                            # admission: the before/after number for
+                            # fused chunked prefill (both stay 0 with
+                            # fusion on — staging never blocks decode).
+                            self.metrics.inc("prefill_stall_ms", stall_ms)
+                        if stalled:
+                            self.metrics.inc(
+                                "decode_stalled_tokens", stalled
                             )
                         self._dispatch_cum += dispatches
                         self._token_cum += tokens

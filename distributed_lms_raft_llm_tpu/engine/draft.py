@@ -73,6 +73,77 @@ def build_drafts(
     return jnp.where(has[:, None], drafts, last_tok[:, None])
 
 
+def build_drafts_ngram(
+    transcript: jax.Array,
+    match_valid: jax.Array,
+    prev_tok: jax.Array,
+    last_tok: jax.Array,
+    k: int,
+) -> jax.Array:
+    """Per-row n-gram TABLE proposals: [B, k] modal continuations.
+
+    Prompt-lookup (`build_drafts`) proposes the continuation of the most
+    RECENT n-gram match — the right bet for greedy decode, where the
+    model's argmax restates its most recent phrasing. At temperature>0
+    the stream stops being self-copying and recency becomes a weak
+    signal: the verifier accepts a draft with probability p(d), so the
+    draft that maximizes acceptance is the MODAL continuation of the
+    current context under the row's own empirical n-gram distribution.
+    This drafter builds that table on the fly from the same transcript
+    plane: every filled position i with transcript[i] == current token
+    casts a vote for its continuation transcript[i+1]; bigram-context
+    matches ((prev, cur) both equal) outvote any number of unigram
+    matches (weight W > any unigram count); the continuation with the
+    most votes wins, recency breaking ties. Each accepted proposal
+    becomes the next lookup context, so the k drafts walk the table like
+    a tiny per-row language model — no extra weights, no extra HBM, one
+    [B, W, W] comparison per draft position (W is the transcript width,
+    ~100s).
+
+    Rows with no match propose the current token repeated — the same
+    throwaway contract as `build_drafts` (the verify forward runs at
+    static width regardless). Selected per engine via `[tutoring]
+    draft_source = "ngram"`.
+    """
+    b, w = transcript.shape
+    pos = jnp.arange(w, dtype=jnp.int32)
+    # Continuation at anchor i is transcript[i+1]; the wrapped last
+    # column is unreachable (match_valid never marks the final slot — it
+    # requires k filled continuation slots after the anchor).
+    nxt = jnp.concatenate([transcript[:, 1:], transcript[:, :1]], axis=1)
+    prev_ids = jnp.concatenate(
+        [jnp.full_like(transcript[:, :1], -1), transcript[:, :-1]], axis=1
+    )
+    prev_ok = jnp.concatenate(
+        [jnp.zeros_like(match_valid[:, :1]), match_valid[:, :-1]], axis=1
+    )
+    same = (nxt[:, :, None] == nxt[:, None, :])  # continuation classes
+    prev, cur = prev_tok, last_tok
+    drafts = []
+    for _ in range(k):
+        uni = (transcript == cur[:, None]) & match_valid
+        bi = uni & prev_ok & (prev_ids == prev[:, None])
+        votes = (
+            jnp.sum(same & uni[:, None, :], axis=-1).astype(jnp.int32)
+            + jnp.sum(same & bi[:, None, :], axis=-1).astype(jnp.int32) * w
+        )
+        score = jnp.where(uni, votes, 0)
+        # Lexicographic (score, recency) argmax without overflow: most
+        # recent anchor among the max-score class.
+        m = jnp.max(score, axis=1, keepdims=True)
+        best = jnp.argmax(
+            jnp.where((score == m) & uni, pos[None, :], -1), axis=1
+        )
+        has = m[:, 0] > 0
+        proposed = jnp.where(
+            has, jnp.take_along_axis(nxt, best[:, None], axis=1)[:, 0],
+            cur,
+        )
+        drafts.append(proposed)
+        prev, cur = cur, proposed
+    return jnp.stack(drafts, axis=1)
+
+
 def _processed_top(
     logits: jax.Array, seen: jax.Array, params: SamplingParams
 ) -> Tuple[jax.Array, jax.Array]:

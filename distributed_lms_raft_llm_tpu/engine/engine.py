@@ -94,6 +94,15 @@ class EngineConfig:
     # raggedly by per-row accepted counts). Wins where per-step fixed
     # costs dominate: low batch, or a paged batch running below capacity.
     spec_tokens: int = 0
+    # Spec draft source: "prompt_lookup" (most-recent n-gram continuation,
+    # engine/draft.build_drafts — the right bet for greedy streams) or
+    # "ngram" (per-slot modal-continuation n-gram table,
+    # build_drafts_ngram — higher acceptance on stochastic temperature>0
+    # streams, where recency stops predicting what the sampler emits).
+    # "ngram" is a PagedEngine feature (the table reads the SlotState
+    # transcript); TutoringEngine rejects it rather than silently
+    # drafting differently than configured.
+    draft_source: str = "prompt_lookup"
     dtype: Any = jnp.bfloat16
     # Serving stores weights in bf16: halves the HBM read per decode step
     # versus f32 (the decode loop is memory-bound — every step streams all
@@ -111,6 +120,12 @@ class TutoringEngine:
                 "spec_tokens and fused_attention are mutually exclusive: "
                 "the pallas decode kernel is single-query, the verify "
                 "window is k+1 wide"
+            )
+        if config.spec_tokens > 0 and config.draft_source != "prompt_lookup":
+            raise ValueError(
+                f"draft_source {config.draft_source!r} is a paged-engine "
+                "feature (the n-gram table reads the per-slot SlotState "
+                "transcript); TutoringEngine drafts via prompt_lookup only"
             )
         self.family, self.cfg = registry.resolve(
             config.model, config.dtype, config.param_dtype

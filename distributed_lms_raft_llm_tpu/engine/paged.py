@@ -53,6 +53,27 @@ Four jitted program families, compiled once each:
   (chunks until some live slot MUST free, `_slack_chunks`) — wide under
   saturation, down to the chunk loop exactly at the boundary a waiting
   request can actually join.
+- `_stage`/`_megastep`+prefill phase (`prefill_chunk_tokens > 0`):
+  stall-free fused admission. The sequential admission above still runs
+  prefill as its own program BETWEEN decode dispatches — every arriving
+  prompt pauses the whole decode train for a full (or suffix-only)
+  prefill (the dominant admission stall once megasteps removed the host
+  from the chunk loop). With fusion on, admission is *staged* instead:
+  `_stage_program` writes the prompt ids into the slot's transcript row
+  and arms a staged-admission plane riding in SlotState (staged flag,
+  chunk cursor, true length, first-token rng), with cached shared-prefix
+  blocks spliced straight into the slot's pages (`_stage_block`); then
+  every megastep scan iteration runs ONE token-budgeted prefill chunk
+  (`prefill_chunk_tokens` positions) for the oldest staged slot — the
+  Sarathi-Serve chunked-prefill idea, device-resident — before the
+  decode chunk advances the live slots. The final chunk samples the
+  first token with the cold path's rng/seen-mask contract and flips the
+  slot live mid-megastep; `flipped`/`firsts` planes come back stacked
+  [K, S] so the one batched reap learns admission outcomes with zero
+  extra syncs. Decode never waits on admission (`decode_stalled_tokens`
+  stays 0), prefill compute fills the scan's pipeline bubbles, and
+  greedy outputs are bit-identical to the sequential prefill-then-decode
+  path at any K and chunk budget (tests/test_fused_prefill.py).
 
 The reference has no analogue (HF `generate`, one request at a time —
 reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
@@ -78,7 +99,7 @@ from ..parallel import partition
 from ..utils import tokenizer as tok_lib
 from ..utils.compilation import enable_compilation_cache
 from ..utils.guards import intended_transfer
-from .draft import build_drafts, verify_window
+from .draft import build_drafts, build_drafts_ngram, verify_window
 from .engine import EngineConfig
 from .generate import pick_bucket
 from .prefix_cache import (
@@ -87,6 +108,7 @@ from .prefix_cache import (
     Match,
     PrefixCache,
     plan_partial,
+    plan_staged,
 )
 from .program_inventory import effective_megastep_max, megastep_ladder
 from .sampling import (
@@ -110,8 +132,26 @@ class SlotState(NamedTuple):
     # (right-padded: transcript slot j = the token whose KV lives — or
     # will live — in cache slot j). Slots <= cache.length hold real
     # tokens. Feeds the prompt-lookup drafter in spec mode; carried
-    # unchanged (aliased in place by donation) by the plain step.
+    # unchanged (aliased in place by donation) by the plain step. With
+    # fused admission the transcript doubles as the staged prompt's
+    # device-side id store: `_stage_program` writes the whole right-padded
+    # prompt here and the in-scan prefill chunks read their ids back out.
     transcript: jax.Array
+    # Staged-admission plane (fused chunked prefill; all [S], inert zeros
+    # when `prefill_chunk_tokens` is 0): `staged` marks slots whose
+    # prompt is being prefilled inside the megastep scan, `stage_cursor`
+    # the next absolute prefill position (starts at the spliced
+    # shared-prefix length), `stage_len` the true prompt length,
+    # `stage_seq` the host's staging sequence number (FIFO service order
+    # — slot index would starve an early admission whenever churn
+    # restages a lower slot), and `stage_rng` the raw key data the flip
+    # samples the first token with (the same host split sequence the
+    # sequential _admit would have consumed).
+    staged: jax.Array       # [S] bool
+    stage_cursor: jax.Array  # [S] int32
+    stage_len: jax.Array     # [S] int32
+    stage_seq: jax.Array     # [S] int32
+    stage_rng: jax.Array     # [S, *key_data] uint32
 
 
 @dataclasses.dataclass
@@ -125,6 +165,11 @@ class _Request:
     # was known still carry this request in their slot snapshot and must
     # skip it (see PagedEngine.step pipelining).
     finished: bool = False
+    # False while the request is STAGED (fused admission: prompt handed to
+    # the device, prefill advancing inside the megastep scan, first token
+    # not yet sampled). `tokens` still holds the prompt until the flip is
+    # reaped; _live()/_slack_chunks treat staged requests as not-yet-live.
+    live: bool = True
 
 
 def _state_spec(x: jax.Array) -> jax.sharding.PartitionSpec:
@@ -247,26 +292,99 @@ def _load_block_program(cache0: KVCache, block: KVBlock, off) -> KVCache:
     return cache0._replace(k=k, v=v, ks=ks, vs=vs)
 
 
-def _export_block_program(c1: KVCache, off, *, block: int) -> KVBlock:
-    """Slice one block-aligned KV run out of a completed prefill's cache
-    — a fresh immutable copy the radix tree owns. Publishing copies
-    rather than aliasing: `c1` is transient admission state, and a tree
-    that aliased it would see its buffers donated away by the next
-    install."""
-    l, b, h, _, dh = c1.k.shape
+def _export_block_program(c1: KVCache, off, slot, *, block: int) -> KVBlock:
+    """Slice one block-aligned KV run out of a prefilled cache — a fresh
+    immutable copy the radix tree owns. `slot` selects the sequence: 0
+    for the sequential path's single-slot admission cache, the live slot
+    index when fused admission publishes straight out of the multi-slot
+    state (the prompt region 0..prompt_len-1 is never rewritten by
+    decode, which scatters at >= prompt_len). Publishing copies rather
+    than aliasing: the source is transient engine state, and a tree that
+    aliased it would see its buffers donated away by the next program."""
+    l, _, h, _, dh = c1.k.shape
     zero = jnp.zeros((), jnp.int32)
     off = jnp.asarray(off, jnp.int32)
-    k = jax.lax.dynamic_slice(c1.k, (zero, zero, zero, off, zero),
-                              (l, b, h, block, dh))
-    v = jax.lax.dynamic_slice(c1.v, (zero, zero, zero, off, zero),
-                              (l, b, h, block, dh))
+    slot = jnp.asarray(slot, jnp.int32)
+    k = jax.lax.dynamic_slice(c1.k, (zero, slot, zero, off, zero),
+                              (l, 1, h, block, dh))
+    v = jax.lax.dynamic_slice(c1.v, (zero, slot, zero, off, zero),
+                              (l, 1, h, block, dh))
     ks = vs = None
     if c1.quantized:
-        ks = jax.lax.dynamic_slice(c1.ks, (zero, zero, zero, off),
-                                   (l, b, h, block))
-        vs = jax.lax.dynamic_slice(c1.vs, (zero, zero, zero, off),
-                                   (l, b, h, block))
+        ks = jax.lax.dynamic_slice(c1.ks, (zero, slot, zero, off),
+                                   (l, 1, h, block))
+        vs = jax.lax.dynamic_slice(c1.vs, (zero, slot, zero, off),
+                                   (l, 1, h, block))
     return KVBlock(k=k, v=v, ks=ks, vs=vs)
+
+
+def _stage_program(state: SlotState, slot, ids, true_len, cursor0, seq,
+                   rng_raw) -> SlotState:
+    """Arm one slot's staged admission (fused chunked prefill): write the
+    right-padded prompt into the slot's transcript row and set the
+    staged-admission plane — prefill then advances inside the megastep
+    scan (`_admission_chunk`), one `prefill_chunk_tokens` chunk per
+    iteration, until the flip samples the first token.
+
+    `cursor0` is the already-spliced shared-prefix length (0 cold; the
+    caller stages cached blocks into the slot's pages via `_stage_block`
+    first). The slot's cache length is parked at width-1: the decode
+    phase still computes a forward for every slot, and an inactive row
+    scatters its (garbage) KV at its length position — parked above the
+    prompt region, the staged pages can never be corrupted by it (the
+    same clamp position a dead slot writes to). Donates the state like
+    `_install`."""
+    zero = jnp.zeros((), jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    width = state.transcript.shape[1]
+    transcript = jax.lax.dynamic_update_slice(
+        state.transcript, ids, (slot, zero)
+    )
+    return state._replace(
+        cache=state.cache._replace(
+            length=state.cache.length.at[slot].set(width - 1)
+        ),
+        active=state.active.at[slot].set(False),
+        transcript=transcript,
+        staged=state.staged.at[slot].set(True),
+        stage_cursor=state.stage_cursor.at[slot].set(
+            jnp.asarray(cursor0, jnp.int32)
+        ),
+        stage_len=state.stage_len.at[slot].set(
+            jnp.asarray(true_len, jnp.int32)
+        ),
+        stage_seq=state.stage_seq.at[slot].set(
+            jnp.asarray(seq, jnp.int32)
+        ),
+        stage_rng=state.stage_rng.at[slot].set(rng_raw),
+    )
+
+
+def _stage_block_program(state: SlotState, block: KVBlock, slot,
+                         off) -> SlotState:
+    """Splice one immutable shared KV block straight into a slot's pages
+    of the LIVE multi-slot cache at token offset `off` (fused admission's
+    counterpart of `_load_block`; one compiled program per cache width).
+    Donates the state — a private accumulator between dispatches — and
+    NEVER the block: tree blocks are shared structure
+    (engine/prefix_cache.py), and donating one would free KV other
+    admissions still splice from."""
+    zero = jnp.zeros((), jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    off = jnp.asarray(off, jnp.int32)
+    k = jax.lax.dynamic_update_slice(state.cache.k, block.k,
+                                     (zero, slot, zero, off, zero))
+    v = jax.lax.dynamic_update_slice(state.cache.v, block.v,
+                                     (zero, slot, zero, off, zero))
+    ks = vs = None
+    if state.cache.quantized:
+        ks = jax.lax.dynamic_update_slice(state.cache.ks, block.ks,
+                                          (zero, slot, zero, off))
+        vs = jax.lax.dynamic_update_slice(state.cache.vs, block.vs,
+                                          (zero, slot, zero, off))
+    return state._replace(
+        cache=state.cache._replace(k=k, v=v, ks=ks, vs=vs)
+    )
 
 
 def cfg_tmax(cfg, sampling: SamplingParams, bucket: int) -> int:
@@ -305,7 +423,7 @@ def _install_program(state: SlotState, slot, c1: KVCache, ids, true_len,
         state.transcript, ids, (slot, zero)
     )
     transcript = transcript.at[slot, true_len].set(first)
-    return SlotState(
+    return state._replace(
         cache=KVCache(ck, cv, lengths, ks=cks, vs=cvs),
         tok=state.tok.at[slot].set(first),
         active=state.active.at[slot].set(first != eos_id),
@@ -379,12 +497,11 @@ def _step_program(params, state: SlotState, rng, *, cfg, sampling,
             s.active[:, None], update_seen(s.seen, nxt), s.seen
         )
         return (
-            SlotState(
+            s._replace(
                 cache=cache._replace(length=lengths),
                 tok=nxt,
                 active=still,
                 seen=seen,
-                transcript=s.transcript,
             ),
             nxt,
         )
@@ -396,6 +513,7 @@ def _step_program(params, state: SlotState, rng, *, cfg, sampling,
 def _spec_step_program(
     params, state: SlotState, rng, *, cfg, sampling, eos_id: int,
     pad_id: int, model, spec_tokens: int, chunk: int = 1,
+    draft_fn=build_drafts,
 ) -> Tuple[SlotState, jax.Array, jax.Array, jax.Array]:
     """`chunk` speculative verify windows for all S slots.
 
@@ -438,7 +556,7 @@ def _spec_step_program(
             s.transcript, jnp.maximum(offs - 1, 0)[:, None], axis=1
         )[:, 0]
         match_valid = pos_w <= (offs - k)[:, None]
-        drafts = build_drafts(s.transcript, match_valid, prev, s.tok, k)
+        drafts = draft_fn(s.transcript, match_valid, prev, s.tok, k)
 
         # One forward over [last, d_1..d_k]: KV scatters at slots
         # offs..offs+k, queries attend causally (key slot <= query slot) —
@@ -473,7 +591,7 @@ def _spec_step_program(
         )
         lengths = jnp.where(s.active, offs + m, s.cache.length)
         return (
-            SlotState(
+            s._replace(
                 cache=cache._replace(length=lengths),
                 tok=new_tok,
                 active=s.active & ~hit_eos,
@@ -489,9 +607,131 @@ def _spec_step_program(
     return state, emitted, counts, state.active.astype(jnp.int8)
 
 
+def _admission_chunk(params, s: SlotState, *, cfg, sampling, model,
+                     eos_id: int, pad_id: int, prefill_chunk: int):
+    """One token-budgeted prefill chunk for the oldest staged admission —
+    the fused-admission phase of a megastep scan iteration.
+
+    If any slot is staged: slice that slot's pages out of the live cache,
+    forward the next `prefill_chunk` prompt ids from its transcript row
+    (KV scatters at the per-row ragged cursor offset — out-of-range pad
+    tails of the final chunk are dropped by the scatter, never clamped
+    into real pages), and splice the updated pages back. When the cursor
+    covers the true length, the flip: sample the first token from the
+    last real position's logits with the staged rng and the full-prompt
+    seen mask — the exact contract `_prefill_program` feeds `_install` —
+    then mark the slot live (length=true_len, transcript gains the first
+    token at its cache slot, active unless eos). The computation per
+    real position is identical to the cold prefill's (same KV values,
+    same causal key set, pad tails masked), so the flipped slot's stream
+    is bit-identical to the sequential path's.
+
+    Returns (state, flipped [S] bool, firsts [S] int32) — one-hot at the
+    flipped slot. A `lax.cond` skips all of it when nothing is staged,
+    so the steady-state decode iteration pays nothing for the fused
+    capability.
+    """
+    n_slots = s.tok.shape[0]
+    no_flip = jnp.zeros((n_slots,), jnp.bool_)
+    no_first = jnp.full((n_slots,), pad_id, jnp.int32)
+
+    def run(s: SlotState):
+        c = prefill_chunk
+        zero = jnp.zeros((), jnp.int32)
+        # FIFO service: the staged slot with the lowest staging sequence
+        # number (slot INDEX would let churn restage a lower slot and
+        # starve an earlier admission's prefill indefinitely).
+        big = jnp.iinfo(jnp.int32).max
+        slot = jnp.argmin(
+            jnp.where(s.staged, s.stage_seq, big)
+        ).astype(jnp.int32)
+        cur = s.stage_cursor[slot]
+        tl = s.stage_len[slot]
+        l, _, h, w, dh = s.cache.k.shape
+        ck = jax.lax.dynamic_slice(
+            s.cache.k, (zero, slot, zero, zero, zero), (l, 1, h, w, dh)
+        )
+        cv = jax.lax.dynamic_slice(
+            s.cache.v, (zero, slot, zero, zero, zero), (l, 1, h, w, dh)
+        )
+        cks = cvs = None
+        if s.cache.quantized:
+            cks = jax.lax.dynamic_slice(
+                s.cache.ks, (zero, slot, zero, zero), (l, 1, h, w)
+            )
+            cvs = jax.lax.dynamic_slice(
+                s.cache.vs, (zero, slot, zero, zero), (l, 1, h, w)
+            )
+        c1 = KVCache(ck, cv, cur[None], ks=cks, vs=cvs)
+        ids = jax.lax.dynamic_slice(s.transcript, (slot, cur), (1, c))
+        # Pad-tail positions clamp to the last real position, exactly as
+        # the cold prefill's position plane does; their outputs/KV are
+        # garbage nothing reads (causal frontier + the decode kv_mask).
+        positions = jnp.minimum(
+            cur + jnp.arange(c, dtype=jnp.int32), tl - 1
+        )[None, :]
+        logits, c1 = model.forward(
+            params, cfg, ids, cache=c1, positions=positions
+        )
+        k2 = jax.lax.dynamic_update_slice(
+            s.cache.k, c1.k, (zero, slot, zero, zero, zero)
+        )
+        v2 = jax.lax.dynamic_update_slice(
+            s.cache.v, c1.v, (zero, slot, zero, zero, zero)
+        )
+        ks2 = vs2 = None
+        if s.cache.quantized:
+            ks2 = jax.lax.dynamic_update_slice(
+                s.cache.ks, c1.ks, (zero, slot, zero, zero)
+            )
+            vs2 = jax.lax.dynamic_update_slice(
+                s.cache.vs, c1.vs, (zero, slot, zero, zero)
+            )
+        done = cur + c >= tl
+        li = jnp.clip(tl - 1 - cur, 0, c - 1)
+        last = jax.lax.dynamic_index_in_dim(logits[0], li, 0,
+                                            keepdims=False)
+        row = jax.lax.dynamic_slice(
+            s.transcript, (slot, zero), (1, s.transcript.shape[1])
+        )
+        valid = (jnp.arange(s.transcript.shape[1]) < tl)[None, :]
+        seen0 = seen_mask_from_ids(row, valid, cfg.vocab_size)
+        rng = jax.random.wrap_key_data(s.stage_rng[slot])
+        first = sample_step(rng, last[None, :], seen0, sampling)[0]
+        seen1 = update_seen(seen0, first[None])[0]
+        new = s._replace(
+            cache=s.cache._replace(
+                k=k2, v=v2, ks=ks2, vs=vs2,
+                length=s.cache.length.at[slot].set(
+                    jnp.where(done, tl, s.cache.length[slot])
+                ),
+            ),
+            tok=s.tok.at[slot].set(jnp.where(done, first, s.tok[slot])),
+            active=s.active.at[slot].set(done & (first != eos_id)),
+            seen=s.seen.at[slot].set(
+                jnp.where(done, seen1, s.seen[slot])
+            ),
+            transcript=s.transcript.at[slot, tl].set(
+                jnp.where(done, first, s.transcript[slot, tl])
+            ),
+            staged=s.staged.at[slot].set(~done),
+            stage_cursor=s.stage_cursor.at[slot].set(cur + c),
+        )
+        return (
+            new,
+            no_flip.at[slot].set(done),
+            no_first.at[slot].set(jnp.where(done, first, pad_id)),
+        )
+
+    return jax.lax.cond(
+        jnp.any(s.staged), run, lambda s: (s, no_flip, no_first), s
+    )
+
+
 def _megastep_program(params, state: SlotState, rngs, *, cfg, sampling,
                       eos_id: int, pad_id: int, model, spec_tokens: int,
-                      chunk: int):
+                      chunk: int, prefill_chunk: int = 0,
+                      draft_fn=build_drafts):
     """K `chunk`-token steps back-to-back on device: one dispatch, one
     readback, K*chunk decode iterations.
 
@@ -509,6 +749,11 @@ def _megastep_program(params, state: SlotState, rngs, *, cfg, sampling,
     - plain: (state, toks [K, chunk, S], active [K, S] int8, dead int32)
     - spec:  (state, emitted [K, chunk, S, k+1], counts [K, chunk, S],
               active [K, S] int8, dead int32)
+    - fused admission (`prefill_chunk > 0`): either of the above plus
+      (flipped [K, S] bool, firsts [K, S] int32) — per iteration, the
+      slot whose staged prefill completed and the first token it
+      sampled, so the batched reap learns admission outcomes without an
+      extra sync (see `_admission_chunk`).
 
     `active[j]` is the post-chunk-j snapshot — the same fresh non-donated
     plane the single-chunk program returns, K of them — so the host's
@@ -521,43 +766,80 @@ def _megastep_program(params, state: SlotState, rngs, *, cfg, sampling,
     so it burns one pad lane per remaining scan iteration — and in spec
     mode each lane is a verify window whose forward computes
     spec_tokens+1 token positions. dead = chunk * lane_tokens * sum over
-    j<K-1 of |slots active at megastep entry but inactive after chunk j|
-    (lane_tokens = spec_tokens+1 when speculating, else 1) — zero at K=1
+    j<K-1 of |slots LIVE by chunk j but inactive after it| (live =
+    active at entry, or flipped live by a fused admission at an earlier
+    iteration — a flip-then-eos inside one megastep strands lanes too;
+    lane_tokens = spec_tokens+1 when speculating, else 1) — zero at K=1
     (the host reaps every chunk), and exactly the positions a chunk-loop
     host reap would have freed. Slots already dead at entry (empty, or
-    reaped earlier) are capacity idle in both modes and do not count.
+    reaped earlier) are capacity idle in both modes and do not count,
+    and a staged slot's pre-flip iterations are admission work, never
+    stranded decode.
     """
     started = state.active  # read before the scan consumes the donation
 
     def one_chunk(s: SlotState, r):
+        if prefill_chunk:
+            # Fused admission: one bounded prefill chunk for the oldest
+            # staged slot BEFORE the decode chunk, so a flip's first
+            # decode token lands in this same iteration's token plane —
+            # the slot joins the train at a scan-iteration boundary, not
+            # a dispatch boundary.
+            s, flipped, firsts = _admission_chunk(
+                params, s, cfg=cfg, sampling=sampling, model=model,
+                eos_id=eos_id, pad_id=pad_id,
+                prefill_chunk=prefill_chunk,
+            )
+            extra = (flipped, firsts)
+        else:
+            extra = ()
         if spec_tokens:
             s, emitted, counts, active = _spec_step_program(
                 params, s, r, cfg=cfg, sampling=sampling, eos_id=eos_id,
                 pad_id=pad_id, model=model, spec_tokens=spec_tokens,
-                chunk=chunk,
+                chunk=chunk, draft_fn=draft_fn,
             )
-            return s, (emitted, counts, active)
+            return s, (emitted, counts, active) + extra
         s, toks, active = _step_program(
             params, s, r, cfg=cfg, sampling=sampling, eos_id=eos_id,
             pad_id=pad_id, model=model, chunk=chunk,
         )
-        return s, (toks, active)
+        return s, (toks, active) + extra
 
     state, outs = jax.lax.scan(one_chunk, state, rngs)
+    if prefill_chunk:
+        flipped, firsts = outs[-2], outs[-1]  # [K, S] admission planes
+        outs = outs[:-2]
     active = outs[-1]  # [K, S] int8 post-chunk snapshots
     lane_tokens = chunk * ((spec_tokens + 1) if spec_tokens else 1)
+    # A lane is stranded from the first iteration it is dead AFTER having
+    # been live: live = active at entry, or flipped live by a fused
+    # admission at any earlier iteration (a flip-then-eos inside one
+    # megastep burns real pad lanes too). Pre-flip staged iterations are
+    # admission work, not stranded decode, and never count.
+    if prefill_chunk:
+        live = started[None, :] | (
+            jnp.cumsum(flipped.astype(jnp.int32), axis=0) > 0
+        )
+    else:
+        live = jnp.broadcast_to(started[None, :], active.shape)
     dead = jnp.asarray(lane_tokens, jnp.int32) * jnp.sum(
-        (started[None, :] & (active[:-1] == 0)).astype(jnp.int32)
+        (live[:-1] & (active[:-1] == 0)).astype(jnp.int32)
     )
     if spec_tokens:
         emitted, counts, _ = outs
-        return state, emitted, counts, active, dead
-    toks, _ = outs
-    return state, toks, active, dead
+        res = (state, emitted, counts, active, dead)
+    else:
+        toks, _ = outs
+        res = (state, toks, active, dead)
+    if prefill_chunk:
+        res = res + (flipped, firsts)
+    return res
 
 
 def next_megastep_k(current: int, ladder: Sequence[int], pending: int,
-                    slack_chunks: Optional[int] = None) -> int:
+                    slack_chunks: Optional[int] = None,
+                    fused: bool = False) -> int:
     """TTFT-aware megastep size controller (pure; one decision per
     dispatch). `ladder` is the warmed rung list (`megastep_ladder`,
     ascending, starting at 1).
@@ -584,13 +866,26 @@ def next_megastep_k(current: int, ladder: Sequence[int], pending: int,
     spec over-acceptance) can still strand a lane for up to the
     in-progress K*chunk steps — that exposure is the dead-lane account
     (`megastep_dead_lane_tokens`). slack_chunks=None (no live slot to
-    bound) falls to the floor."""
+    bound) falls to the floor.
+
+    Fused staged admission (`fused=True`) re-derives the horizon math:
+    an admission no longer costs a full prefill dispatch at a boundary —
+    it is STAGED there (one async program) and its prefill chunks drain
+    through the scan iterations themselves, so a boundary's only
+    admission value is handing a freed slot to the stager. Shrinking to
+    the K=1 chunk loop therefore buys nothing it used to: the floor
+    rises to the second rung (K stays wide — >= 2 — under a non-empty
+    pending queue, the pinned saturation behavior), while the slack cap
+    still aligns a boundary with the next guaranteed slot-free so
+    staging starts promptly."""
     if len(ladder) <= 1:
         return ladder[0] if ladder else 1
     if pending <= 0:
         i = ladder.index(current) if current in ladder else 0
         return ladder[min(len(ladder) - 1, i + 1)]
     cap = 1 if slack_chunks is None else max(1, slack_chunks)
+    if fused:
+        cap = max(cap, ladder[1])
     return max(k for k in ladder if k <= cap)
 
 
@@ -609,7 +904,8 @@ class PagedEngine:
                  inflight: int = 2, megastep: int = 1,
                  megastep_max: int = 0, prefix_cache: bool = False,
                  prefix_cache_blocks: int = 512,
-                 prefix_block_tokens: int = BLOCK_TOKENS):
+                 prefix_block_tokens: int = BLOCK_TOKENS,
+                 prefill_chunk_tokens: int = 0):
         enable_compilation_cache()
         self.config = config
         # Tokens per dispatched step program — see _step_program. Mid-chunk
@@ -732,6 +1028,38 @@ class PagedEngine:
                 block_tokens=self.prefix_block_tokens,
                 max_blocks=max(1, prefix_cache_blocks),
             )
+        # Fused chunked prefill (stall-free admission): with
+        # `prefill_chunk_tokens > 0`, admissions are STAGED into SlotState
+        # and prefill advances inside the megastep scan — one bounded
+        # chunk per iteration — instead of dispatching a blocking prefill
+        # program between decode dispatches. The budget is clamped so a
+        # final chunk's pad-tail ids still fit the transcript slice
+        # window (the slice starts at cursor <= bucket-1 and must end
+        # inside the cache width = bucket + max_new + spec overhang).
+        self.fused = prefill_chunk_tokens > 0
+        self.prefill_chunk = 0
+        if self.fused:
+            self.prefill_chunk = max(1, min(
+                prefill_chunk_tokens,
+                config.sampling.max_new_tokens + self._spec_extra + 1,
+            ))
+            if self.spec and config.sampling.max_new_tokens < 2:
+                # The staged slot's parked write position (width-1-k in
+                # spec mode) must sit above the prompt region; max_new=1
+                # would park it inside the staged pages.
+                raise ValueError(
+                    "prefill_chunk_tokens with spec_tokens requires "
+                    "max_new_tokens >= 2 (staged-slot parking position)"
+                )
+        if config.draft_source not in ("prompt_lookup", "ngram"):
+            raise ValueError(
+                f"unknown draft_source {config.draft_source!r}; expected "
+                "'prompt_lookup' or 'ngram'"
+            )
+        self._draft_fn = (
+            build_drafts_ngram if config.draft_source == "ngram"
+            else build_drafts
+        )
 
         if config.checkpoint:
             sd = convert.load_safetensors(config.checkpoint)
@@ -774,7 +1102,8 @@ class PagedEngine:
             self._step = jax.jit(
                 partial(_spec_step_program, eos_id=self.tokenizer.eos_id,
                         pad_id=self.tokenizer.pad_id, chunk=self.chunk,
-                        spec_tokens=self.spec, **statics),
+                        spec_tokens=self.spec, draft_fn=self._draft_fn,
+                        **statics),
                 donate_argnums=(1,),
             )
         else:
@@ -785,15 +1114,30 @@ class PagedEngine:
                 donate_argnums=(1,),
             )
         # K>=2 rungs dispatch through the megastep program (K=1 stays on
-        # _step); the K axis rides in on the stacked rng shape, so each
-        # warmed rung is one compiled program per width. Created even when
-        # the ladder is [1] (zero warmed programs) so the inventory guard
-        # sees one stable program set.
+        # _step — except under fused admission, where EVERY rung including
+        # K=1 dispatches through the megastep so the in-scan prefill
+        # phase always runs); the K axis rides in on the stacked rng
+        # shape, so each warmed rung is one compiled program per width.
+        # Created even when the ladder is [1] (zero warmed programs
+        # sequential-mode) so the inventory guard sees one stable program
+        # set.
         self._megastep = jax.jit(
             partial(_megastep_program, eos_id=self.tokenizer.eos_id,
                     pad_id=self.tokenizer.pad_id, chunk=self.chunk,
-                    spec_tokens=self.spec, **statics),
+                    spec_tokens=self.spec, prefill_chunk=self.prefill_chunk,
+                    draft_fn=self._draft_fn, **statics),
             donate_argnums=(1,),
+        )
+        # Fused staged admission programs (zero warmed programs when
+        # `prefill_chunk_tokens` is 0 — same stable-program-set precedent
+        # as _megastep). `_stage` donates the live state like _install;
+        # `_stage_block` donates ONLY the state accumulator, never the
+        # shared tree block.
+        self._stage = jax.jit(
+            partial(_stage_program), donate_argnums=(0,),
+        )
+        self._stage_block = jax.jit(
+            partial(_stage_block_program), donate_argnums=(0,),
         )
         # Wrapped in partial like the other programs — NOT for the statics
         # (it has none to bind) but for cache identity: jax.jit shares one
@@ -820,12 +1164,15 @@ class PagedEngine:
         #  per-chunk snapshots for a megastep (the reap flattens the K
         #  axis and keys dead-slot detection off the FINAL snapshot),
         #  dead-lane scalar device array for a megastep else None,
+        #  flipped [K, S] bool / firsts [K, S] int32 fused-admission
+        #  planes (None without fused prefill),
         #  slot->request snapshot at dispatch time).
         # Every device entry is a fresh non-donated buffer (see
         # _step_program's snapshot note), so chunk-loop and megastep
         # dispatches pipeline under the same donation invariants.
         self._inflight: List[
             Tuple[jax.Array, Optional[jax.Array], jax.Array,
+                  Optional[jax.Array], Optional[jax.Array],
                   Optional[jax.Array], List[Optional[_Request]]]
         ] = []
         self._next_rid = 0
@@ -869,6 +1216,24 @@ class PagedEngine:
         self._prefix_hit_tokens = 0
         self._prefix_prompt_tokens = 0
         self._prefix_evictions = 0
+        # Admission-stall accounting (the fused-prefill before/after
+        # number, drained by pop_dispatch_stats): host wall seconds the
+        # decode train spent blocked on sequential admission work
+        # (prefill/partial-prefill dispatches + the first-token sync)
+        # while live slots waited, and the proxy token count those slots
+        # would have decoded meanwhile (live slots x chunk per blocking
+        # admission). Both stay 0 by construction under fused staged
+        # admission — staging is one async dispatch and the prefill
+        # chunks ride the scan iterations.
+        self._prefill_stall_s = 0.0
+        self._decode_stalled_tokens = 0
+        # rid -> prompt token list for STAGED requests (req.tokens is
+        # replaced by the generated stream at flip-reap; the fused
+        # publish into the radix tree still needs the prompt ids).
+        self._staged_prompts: Dict[int, List[int]] = {}
+        # Monotonic staging sequence (FIFO service order for the in-scan
+        # prefill phase — see SlotState.stage_seq).
+        self._stage_seq = 0
 
     _PROG_TIMES_MAX = 4096
 
@@ -889,18 +1254,27 @@ class PagedEngine:
         if len(self._prog_times) > self._PROG_TIMES_MAX:
             del self._prog_times[: -self._PROG_TIMES_MAX]
 
-    def pop_dispatch_stats(self) -> Tuple[int, int, int]:
-        """Drain (host_dispatches, emitted_tokens, dead_lane_tokens)
-        accumulated since the last call. dispatches/tokens is the host
-        round trips paid per emitted token — the megastep's target ratio;
-        dead_lane_tokens counts pad lanes already-finished slots decoded
-        inside megasteps before the boundary let the host reap them
-        (zero in chunk-loop mode). The serving queue turns these into the
-        `host_dispatches_per_token` gauge and the
-        `megastep_dead_lane_tokens` counter."""
+    def pop_dispatch_stats(self) -> Tuple[int, int, int, float, int]:
+        """Drain (host_dispatches, emitted_tokens, dead_lane_tokens,
+        prefill_stall_ms, decode_stalled_tokens) accumulated since the
+        last call. dispatches/tokens is the host round trips paid per
+        emitted token — the megastep's target ratio; dead_lane_tokens
+        counts pad lanes already-finished slots decoded inside megasteps
+        before the boundary let the host reap them (zero in chunk-loop
+        mode); prefill_stall_ms is the host wall the decode train spent
+        blocked on sequential admission while live slots waited, and
+        decode_stalled_tokens the proxy tokens those slots would have
+        decoded meanwhile (live slots x chunk per blocking admission —
+        both 0 by construction under fused staged admission). The
+        serving queue turns these into the `host_dispatches_per_token`
+        gauge and the `megastep_dead_lane_tokens`/`prefill_stall_ms`/
+        `decode_stalled_tokens` counters."""
         out = (self._dispatches, self._emitted_tokens,
-               self._dead_lane_tokens)
+               self._dead_lane_tokens, self._prefill_stall_s * 1000.0,
+               self._decode_stalled_tokens)
         self._dispatches = self._emitted_tokens = self._dead_lane_tokens = 0
+        self._prefill_stall_s = 0.0
+        self._decode_stalled_tokens = 0
         return out
 
     def pop_prefix_stats(self) -> Optional[Tuple[int, int, int, int]]:
@@ -947,6 +1321,9 @@ class PagedEngine:
             dtype=self.cfg.dtype,
         )
         cache = cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
+        # Staged-rng plane shape follows the live PRNG impl's key data
+        # (threefry: [2] uint32) so wrap_key_data round-trips exactly.
+        key_shape = jax.random.key_data(jax.random.key(0)).shape
         state = SlotState(
             cache=cache,
             tok=jnp.zeros((self.slots,), jnp.int32),
@@ -955,6 +1332,11 @@ class PagedEngine:
             transcript=jnp.zeros(
                 (self.slots, cache.k.shape[3]), jnp.int32
             ),
+            staged=jnp.zeros((self.slots,), bool),
+            stage_cursor=jnp.zeros((self.slots,), jnp.int32),
+            stage_len=jnp.ones((self.slots,), jnp.int32),
+            stage_seq=jnp.zeros((self.slots,), jnp.int32),
+            stage_rng=jnp.zeros((self.slots,) + key_shape, jnp.uint32),
         )
         # Replicated mesh sharding from birth, in the canonical spelling:
         # raw single-device arrays would key the jit caches differently
@@ -977,6 +1359,11 @@ class PagedEngine:
             active=put(state.active),
             seen=put(state.seen),
             transcript=put(state.transcript),
+            staged=put(state.staged),
+            stage_cursor=put(state.stage_cursor),
+            stage_len=put(state.stage_len),
+            stage_seq=put(state.stage_seq),
+            stage_rng=put(state.stage_rng),
         )
 
     # ------------------------------------------------------------ host API
@@ -1021,7 +1408,16 @@ class PagedEngine:
         width), every width-growth transition, and — with the
         shared-prefix cache enabled — the block export/load programs per
         bucket plus every admissible (bucket, suffix-bucket) partial
-        prefill. Returns seconds."""
+        prefill.
+
+        Fused staged admission replaces the sequential admission set:
+        warmup compiles `_stage` at every admissible (bucket, width)
+        pair, the megastep at every (width, rung) pair INCLUDING rung 1
+        (fused dispatch always goes through the megastep so the prefill
+        phase runs), and — with the shared-prefix cache — the
+        state-export and `_stage_block` splice per width; the sequential
+        prefill/install/partial/load programs compile zero entries.
+        Returns seconds."""
         t0 = time.monotonic()
         buckets = self.buckets
         for width in self.widths:
@@ -1033,6 +1429,16 @@ class PagedEngine:
                     continue  # a prompt this long can't run at this width
                 ids = np.full((1, t), self.tokenizer.pad_id, np.int32)
                 self._rng, rng = jax.random.split(self._rng)
+                if self.fused:
+                    with self.mesh:
+                        self.state = self._stage(
+                            self.state, jnp.asarray(0, jnp.int32),
+                            jnp.asarray(ids), jnp.asarray(1, jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                            jax.random.key_data(rng),
+                        )
+                    continue
                 with self.mesh:
                     c1, first, seen_row = self._prefill(
                         self.params, jnp.asarray(ids),
@@ -1043,6 +1449,35 @@ class PagedEngine:
                         jnp.asarray(ids), jnp.asarray(1, jnp.int32),
                         first, seen_row,
                     )
+            if self.fused:
+                # Every rung dispatches through the megastep when fused
+                # (rung 1 included); the first dispatch consumes the
+                # post-stage state — the exact live stage->megastep
+                # handoff — and lax.cond compiles both admission branches
+                # regardless of the runtime staged flag.
+                for k in self.megastep_ks:
+                    rngs = self._step_keys(k)
+                    self.state = self._canon_state(self.state)
+                    with self.mesh:
+                        self.state = self._megastep(
+                            self.params, self.state, rngs
+                        )[0]
+                if self.prefix_cache is not None and any(
+                    t >= self.prefix_block_tokens for t in buckets
+                ):
+                    # Fused shared-prefix programs per width: publish
+                    # slices blocks straight out of the live state,
+                    # staging splices them straight back in.
+                    with self.mesh:
+                        blk = self._export_block(
+                            self.state.cache, jnp.asarray(0, jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                        )
+                        self.state = self._stage_block(
+                            self.state, blk, jnp.asarray(0, jnp.int32),
+                            jnp.asarray(0, jnp.int32),
+                        )
+                continue
             # Step AFTER an install so the compile covers the live
             # install->step handoff (the state the step really sees);
             # stepping a raw _init_state would key the cache differently.
@@ -1065,7 +1500,7 @@ class PagedEngine:
                 throwaway = self._init_state(wa)
                 with self.mesh:
                     self._grow(throwaway, wb)
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not self.fused:
             # Shared-prefix program domain: one export/load program per
             # prompt bucket wide enough to hold a block, one partial
             # prefill per admissible (bucket, suffix-bucket) pair —
@@ -1084,7 +1519,8 @@ class PagedEngine:
                         self.params, jnp.asarray(ids),
                         jnp.asarray(1, jnp.int32), rng,
                     )
-                    blk = self._export_block(c1, jnp.asarray(0, jnp.int32))
+                    blk = self._export_block(c1, jnp.asarray(0, jnp.int32),
+                                             jnp.asarray(0, jnp.int32))
                 for s in buckets:
                     if s > t - blk_t:
                         continue
@@ -1165,6 +1601,7 @@ class PagedEngine:
         self.ttfts = {}
         self._prog_times = []
         self._queue_waits = {}
+        self._staged_prompts = {}
         self.megastep_k = self._megastep_initial
         # The radix tree itself SURVIVES a reset: its blocks are never
         # donated, so a failed step cannot have deleted them — only the
@@ -1175,11 +1612,7 @@ class PagedEngine:
         self._prefix_pins = {}
         self._prefix_hits = {}
 
-    def _admit(self) -> None:
-        # All free slots fill before any host sync: the prefill+install
-        # programs for every admitted request dispatch back-to-back and
-        # pipeline on device; one blocking readback at the end fetches every
-        # first token (instead of a per-request round-trip stall).
+    def _maybe_rebuild_idle(self) -> None:
         # Idle rebuild: with nothing occupied or in flight, the cache can
         # jump straight to the width the queued work needs (free — it holds
         # no live data), shrinking back after a wide request departs.
@@ -1195,33 +1628,59 @@ class PagedEngine:
             if needed != self.state.cache.k.shape[3]:
                 self.state = self._init_state(needed)
 
+    def _pop_next(self) -> Tuple[_Request, int, int, np.ndarray]:
+        """Take the oldest pending request: record its queue wait, pick
+        its prompt bucket and required cache width, and build the
+        right-padded [1, bucket] id plane both admission paths feed the
+        device."""
+        req = self._pending.pop(0)
+        self._queue_waits[req.rid] = time.monotonic() - req.submit_time
+        self._shed_oldest(self._queue_waits)
+        # Smallest length bucket that fits: a 10-token query prefills a
+        # 16/32-wide program, not the full Tmax-wide one (one compiled
+        # prefill per bucket; the decode cache runs at the width the
+        # widest active request needs).
+        bucket = min(
+            pick_bucket(req.prompt_len, self.config.length_buckets),
+            self.bucket,
+        )
+        w_req = self._required_width(req.prompt_len)
+        ids = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, : req.prompt_len] = req.tokens
+        return req, bucket, w_req, ids
+
+    def _grow_if_needed(self, w_req: int) -> None:
+        if w_req > self.state.cache.k.shape[3]:
+            # Pad the live cache up (donated, in device order after any
+            # in-flight chunks — their snapshots are separate arrays and
+            # unaffected).
+            t0, t0u = time.monotonic(), time.time()
+            self.state = self._grow(self.state, w_req)
+            self._time_prog("grow", t0, t0u)
+
+    def _admit(self) -> None:
+        # All free slots fill before any host sync: the prefill+install
+        # programs for every admitted request dispatch back-to-back and
+        # pipeline on device; one blocking readback at the end fetches every
+        # first token (instead of a per-request round-trip stall).
+        self._maybe_rebuild_idle()
+        # The stall this admission path charges itself for: while live
+        # slots sit mid-decode, every prefill program and the first-token
+        # sync below occupy the device/host instead of decode chunks —
+        # the number fused staged admission drives to zero.
+        live_train = sum(
+            1 for r in self._slot_req
+            if r is not None and not r.finished and r.live
+        )
+        t_admit0 = time.monotonic()
         admitted: List[Tuple[int, _Request, jax.Array]] = []
         for slot in range(self.slots):
             if self._slot_req[slot] is not None or not self._pending:
                 continue
-            req = self._pending.pop(0)
-            self._queue_waits[req.rid] = time.monotonic() - req.submit_time
-            self._shed_oldest(self._queue_waits)
-            # Smallest length bucket that fits: a 10-token query prefills a
-            # 16/32-wide program, not the full Tmax-wide one (one compiled
-            # prefill per bucket; the decode cache runs at the width the
-            # widest active request needs).
-            bucket = min(
-                pick_bucket(req.prompt_len, self.config.length_buckets),
-                self.bucket,
-            )
-            w_req = self._required_width(req.prompt_len)
-            ids = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-            ids[0, : req.prompt_len] = req.tokens
+            req, bucket, w_req, ids = self._pop_next()
             self._rng, rng = jax.random.split(self._rng)
             with self.mesh:
-                if w_req > self.state.cache.k.shape[3]:
-                    # Pad the live cache up (donated, in device order after
-                    # any in-flight chunks — their snapshots are separate
-                    # arrays and unaffected).
-                    t0, t0u = time.monotonic(), time.time()
-                    self.state = self._grow(self.state, w_req)
-                    self._time_prog("grow", t0, t0u)
+                self._grow_if_needed(w_req)
                 c1, first, seen_row = self._run_prefill(
                     req, bucket, ids, rng
                 )
@@ -1238,6 +1697,11 @@ class PagedEngine:
         with intended_transfer():  # ONE sync for the whole admitted group
             firsts = jax.device_get([f for _, _, f in admitted])
         now = time.monotonic()
+        if live_train:
+            self._prefill_stall_s += now - t_admit0
+            self._decode_stalled_tokens += (
+                live_train * self.chunk * len(admitted)
+            )
         for (slot, req, _), first in zip(admitted, firsts):
             req.tokens = [int(first)]
             self._emitted_tokens += 1
@@ -1245,6 +1709,63 @@ class PagedEngine:
             ttft = now - req.submit_time
             self.ttfts[req.rid] = ttft
             self.last_ttft_s = ttft
+
+    def _stage_admissions(self) -> None:
+        """Fused admission: hand every admissible pending request to the
+        device as a STAGED slot — prompt ids into the transcript row,
+        shared-prefix blocks spliced straight into the slot's pages, the
+        staged-admission plane armed — with zero blocking work. The
+        prefill itself advances inside the megastep scan
+        (`_admission_chunk`), one bounded chunk per iteration, and the
+        flip's first token comes back through the megastep's
+        flipped/firsts planes at the next batched reap: the decode train
+        never pauses for admission."""
+        self._maybe_rebuild_idle()
+        pc = self.prefix_cache
+        for slot in range(self.slots):
+            if self._slot_req[slot] is not None or not self._pending:
+                continue
+            req, bucket, w_req, ids = self._pop_next()
+            self._rng, rng = jax.random.split(self._rng)
+            cursor0 = 0
+            if pc is not None:
+                match = pc.lookup(req.tokens)
+                cursor0 = plan_staged(
+                    match.tokens, req.prompt_len, pc.block_tokens
+                )
+                if cursor0:
+                    pc.acquire(match)
+                    self._prefix_pins[req.rid] = match
+                self._prefix_hit_tokens += cursor0
+                self._prefix_prompt_tokens += req.prompt_len
+                self._prefix_hits[req.rid] = cursor0
+                self._shed_oldest(self._prefix_hits)
+                self._staged_prompts[req.rid] = list(req.tokens)
+            with self.mesh:
+                self._grow_if_needed(w_req)
+                if cursor0:
+                    blocks = match.blocks()[: cursor0 // pc.block_tokens]
+                    t0, t0u = time.monotonic(), time.time()
+                    for i, blk in enumerate(blocks):
+                        self.state = self._stage_block(
+                            self.state, blk, jnp.asarray(slot, jnp.int32),
+                            jnp.asarray(i * pc.block_tokens, jnp.int32),
+                        )
+                    self._dispatches += max(0, len(blocks) - 1)
+                    self._time_prog("stage_block", t0, t0u)
+                t0, t0u = time.monotonic(), time.time()
+                self.state = self._stage(
+                    self.state, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(ids),
+                    jnp.asarray(req.prompt_len, jnp.int32),
+                    jnp.asarray(cursor0, jnp.int32),
+                    jnp.asarray(self._stage_seq, jnp.int32),
+                    jax.random.key_data(rng),
+                )
+                self._time_prog("stage", t0, t0u)
+            self._stage_seq += 1
+            req.live = False
+            self._slot_req[slot] = req
 
     def _required_width(self, prompt_len: int) -> int:
         bucket = min(
@@ -1345,7 +1866,8 @@ class PagedEngine:
 
         def make_block(i: int) -> KVBlock:
             return self._export_block(
-                c1, jnp.asarray(i * blk_t, jnp.int32)
+                c1, jnp.asarray(i * blk_t, jnp.int32),
+                jnp.asarray(0, jnp.int32),
             )
 
         added = pc.insert(
@@ -1356,8 +1878,54 @@ class PagedEngine:
             self._time_prog("export_block", t0, t0u)
         self._prefix_evictions += pc.evict_to_budget()
 
+    def _publish_staged(self, req: _Request, slot: int) -> None:
+        """Fused-admission publish, at flip-reap time: the prompt's KV
+        lives in the slot's pages of the LIVE cache (no standalone
+        admission cache exists), so whole prompt blocks are sliced
+        straight out of `self.state` — fresh copies; safe because decode
+        only ever scatters at positions >= prompt_len and the slot
+        cannot be restaged before this reap returns. Same
+        insert-then-evict policy as the sequential `_publish`."""
+        pc = self.prefix_cache
+        tokens = self._staged_prompts.pop(req.rid, None)
+        if tokens is None:
+            return
+        blk_t = pc.block_tokens
+        t0, t0u = time.monotonic(), time.time()
+        slot_ix = jnp.asarray(slot, jnp.int32)
+
+        def make_block(i: int) -> KVBlock:
+            # Under the mesh context like every other dispatch: the jit
+            # cache keys on the ambient mesh, and warmup compiled these
+            # programs under it.
+            with self.mesh:
+                return self._export_block(
+                    self.state.cache, jnp.asarray(i * blk_t, jnp.int32),
+                    slot_ix,
+                )
+
+        added = pc.insert(
+            tokens[: (req.prompt_len // blk_t) * blk_t], make_block
+        )
+        if added:
+            self._dispatches += added - 1
+            self._time_prog("export_block", t0, t0u)
+        self._prefix_evictions += pc.evict_to_budget()
+
     def _live(self) -> bool:
-        return any(r is not None and not r.finished for r in self._slot_req)
+        return any(
+            r is not None and not r.finished and r.live
+            for r in self._slot_req
+        )
+
+    def _any_staged(self) -> bool:
+        """Any slot whose staged prefill is still advancing inside the
+        scan (fused admission) — device work that must keep dispatching
+        even when no slot is live yet."""
+        return any(
+            r is not None and not r.finished and not r.live
+            for r in self._slot_req
+        )
 
     def _step_keys(self, k: int) -> jax.Array:
         """Stack the next `k` sequential dispatch keys into a [k] key
@@ -1388,7 +1956,10 @@ class PagedEngine:
         by the in-progress K*chunk."""
         rem = None
         for req in self._slot_req:
-            if req is None or req.finished:
+            if req is None or req.finished or not req.live:
+                # Staged requests (fused admission) hold no token budget
+                # yet — their tokens list is still the prompt; they bound
+                # nothing until the flip.
                 continue
             r = req.max_new - len(req.tokens)
             rem = r if rem is None else min(rem, r)
@@ -1396,8 +1967,8 @@ class PagedEngine:
             return None
         chunks = -(-max(0, rem) // self.chunk)  # ceil
         debt = sum(
-            (active.shape[0] if active.ndim == 2 else 1)
-            for _, _, active, _, _ in self._inflight
+            (entry[2].shape[0] if entry[2].ndim == 2 else 1)
+            for entry in self._inflight
         )
         return max(0, chunks - debt)
 
@@ -1417,6 +1988,11 @@ class PagedEngine:
             active=put(state.active),
             seen=put(state.seen),
             transcript=put(state.transcript),
+            staged=put(state.staged),
+            stage_cursor=put(state.stage_cursor),
+            stage_len=put(state.stage_len),
+            stage_seq=put(state.stage_seq),
+            stage_rng=put(state.stage_rng),
             cache=state.cache._replace(length=put(state.cache.length)),
         )
 
@@ -1439,28 +2015,42 @@ class PagedEngine:
         wide under saturation and boundaries exact where a pending
         request can join.
         """
-        self._admit()
-        if self._live():
+        if self.fused:
+            self._stage_admissions()
+        else:
+            self._admit()
+        work = self._live() or self._any_staged()
+        if work:
             self.megastep_k = next_megastep_k(
                 self.megastep_k, self.megastep_ks, len(self._pending),
-                self._slack_chunks(),
+                self._slack_chunks(), fused=self.fused,
             )
-        if self._live() and self.megastep_k > 1:
+        if work and (self.fused or self.megastep_k > 1):
+            # Fused admission dispatches through the megastep at EVERY
+            # rung (K=1 included): the scan body carries the in-scan
+            # prefill phase, so staged slots keep advancing no matter
+            # where the controller sits.
             self.state = self._canon_state(self.state)
             rngs = self._step_keys(self.megastep_k)
             t0, t0u = time.monotonic(), time.time()
             with self.mesh:
-                if self.spec:
-                    (self.state, toks, counts, active,
-                     dead) = self._megastep(self.params, self.state, rngs)
+                self.state, *outs = self._megastep(
+                    self.params, self.state, rngs
+                )
+                if self.fused:
+                    flipped, firsts = outs[-2], outs[-1]
+                    outs = outs[:-2]
                 else:
-                    self.state, toks, active, dead = self._megastep(
-                        self.params, self.state, rngs
-                    )
+                    flipped = firsts = None
+                if self.spec:
+                    toks, counts, active, dead = outs
+                else:
+                    toks, active, dead = outs
                     counts = None
             self._time_prog("megastep", t0, t0u)
-            self._push_inflight(toks, counts, active, dead)
-        elif self._live():
+            self._push_inflight(toks, counts, active, dead, flipped,
+                                firsts)
+        elif work:
             self._rng, rng = jax.random.split(self._rng)
             self.state = self._canon_state(self.state)
             t0, t0u = time.monotonic(), time.time()
@@ -1475,11 +2065,11 @@ class PagedEngine:
                     )
                     counts = None
             self._time_prog("step", t0, t0u)
-            self._push_inflight(toks, counts, active, None)
+            self._push_inflight(toks, counts, active, None, None, None)
         done: List[Tuple[int, str]] = []
         while self._inflight and (
             len(self._inflight) >= self.inflight_limit
-            if self._live()
+            if (self._live() or self._any_staged())
             else True
         ):
             done.extend(self._reap(*self._inflight.pop(0)))
@@ -1488,7 +2078,8 @@ class PagedEngine:
             # here.
         return done
 
-    def _push_inflight(self, toks, counts, active, dead) -> None:
+    def _push_inflight(self, toks, counts, active, dead, flipped,
+                       firsts) -> None:
         """Queue one dispatched program's output buffers for a later reap.
 
         No blocking readback here — but START the device->host copies
@@ -1498,9 +2089,11 @@ class PagedEngine:
         chunk (measured), serializing the loop at ~270 tok/s; with the
         copies in flight the same loop measures ~930 tok/s at chunk=8 and
         ~1.9k at chunk=32 — and a K-chunk megastep rides the same pipe
-        with K-fold fewer round trips.
+        with K-fold fewer round trips. Fused admission's flipped/firsts
+        planes ([K, S]) ride the same pipe, so learning a staged slot
+        went live costs no extra sync.
         """
-        for arr in (toks, counts, active, dead):
+        for arr in (toks, counts, active, dead, flipped, firsts):
             if arr is None:
                 continue
             try:
@@ -1510,14 +2103,22 @@ class PagedEngine:
         # The slot snapshot records which request each column belonged
         # to at dispatch time (a slot reused later belongs to a later
         # dispatch).
-        self._inflight.append((toks, counts, active, dead,
-                               list(self._slot_req)))
+        self._inflight.append((toks, counts, active, dead, flipped,
+                               firsts, list(self._slot_req)))
 
     def _reap(self, toks_dev, counts_dev, active_dev, dead_dev,
+              flipped_dev, firsts_dev,
               slot_snapshot) -> List[Tuple[int, str]]:
         """Read one dispatch's results — a single chunk, or a megastep's
         whole [K, chunk, S] plane in one batched pass — and finish the
-        requests it completed."""
+        requests it completed. Under fused admission the same pass also
+        learns which staged slots FLIPPED live mid-megastep (the
+        flipped/firsts planes): the flip's first token becomes the
+        request's stream head (TTFT recorded here — the first host moment
+        the token exists), its prompt blocks publish into the radix tree
+        straight from the live cache, and its decode walk starts at the
+        flip iteration's rows (earlier rows are pre-flip pad filler, not
+        content)."""
         with intended_transfer():  # THE sync point of the engine loop
             toks = np.asarray(toks_dev)  # [(K,) chunk, S(, k+1)]
             counts = None if counts_dev is None else np.asarray(counts_dev)
@@ -1525,6 +2126,11 @@ class PagedEngine:
             active = np.asarray(active_dev)
             if dead_dev is not None:
                 self._dead_lane_tokens += int(np.asarray(dead_dev))
+            flipped = (None if flipped_dev is None
+                       else np.asarray(flipped_dev))  # [K, S] bool
+            firsts = (None if firsts_dev is None
+                      else np.asarray(firsts_dev))    # [K, S] int32
+        k_axis = active.shape[0] if active.ndim == 2 else 1
         if active.ndim == 2:
             # Megastep: flatten the K axis into one [K*chunk, S] token
             # walk (the per-slot scan below is shape-agnostic in its
@@ -1538,31 +2144,54 @@ class PagedEngine:
             active = active[-1]
         done: List[Tuple[int, str]] = []
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
+        now = time.monotonic()
         for slot, req in enumerate(slot_snapshot):
             if req is None or req.finished:
                 # Empty at dispatch, or finished by an earlier chunk — this
                 # chunk's column holds dead-slot filler.
                 continue
+            start_row = 0
+            if not req.live:
+                # Staged at dispatch time: only a flip makes this column
+                # meaningful. No flip yet -> the prefill is still
+                # advancing; the column is pad filler and the slot's
+                # inactive flag must NOT read as a death.
+                col = (np.zeros((k_axis,), bool) if flipped is None
+                       else flipped[:, slot])
+                if not col.any():
+                    continue
+                j = int(np.argmax(col))
+                req.tokens = [int(firsts[j, slot])]
+                req.live = True
+                self._emitted_tokens += 1
+                ttft = now - req.submit_time
+                self.ttfts[req.rid] = ttft
+                self.last_ttft_s = ttft
+                if self.prefix_cache is not None:
+                    self._publish_staged(req, slot)
+                # The flip iteration's decode chunk is the slot's first:
+                # earlier rows are pre-flip filler.
+                start_row = j * self.chunk
             finished = False
             dead = not bool(active[slot])
             n_before = len(req.tokens)
             if counts is None:
                 # Plain step: one token per scan iteration; a dead slot's
                 # column holds pad filler (detected below).
-                stream, filler = toks[:, slot], True
+                stream, filler = toks[start_row:, slot], True
             else:
                 # Spec step: each scan iteration is a verify window; the
                 # first counts[c, slot] columns are its tokens in order
                 # (contiguous-prefix validity). Inactive windows emit
                 # nothing, so there is no filler to detect. Windows run
                 # while the request was live feed the acceptance stats.
-                col = counts[:, slot]
+                col = counts[start_row:, slot]
                 live = col > 0
                 self._spec_windows += int(np.sum(live))
                 self._spec_emitted += int(np.sum(col))
                 stream = [
-                    t for c in range(toks.shape[0])
-                    for t in toks[c, slot, : int(col[c])]
+                    t for c in range(col.shape[0])
+                    for t in toks[start_row + c, slot, : int(col[c])]
                 ]
                 filler = False
             for t in stream:
@@ -1599,6 +2228,7 @@ class PagedEngine:
                 finished = True
             if finished:
                 req.finished = True
+                self._staged_prompts.pop(req.rid, None)
                 pin = self._prefix_pins.pop(req.rid, None)
                 if pin is not None and self.prefix_cache is not None:
                     # The slot no longer reads shared blocks: unpin its

@@ -140,6 +140,22 @@ def plan_partial(
     return 0, 0
 
 
+def plan_staged(hit_tokens: int, true_len: int, block_tokens: int) -> int:
+    """Fit a cache hit into FUSED staged admission: returns the prefix
+    length to splice (a multiple of `block_tokens`; 0 = cold staging).
+
+    Staged admission has no suffix-bucket program to fit — the uncached
+    suffix is chunked through the megastep scan at any length — so the
+    only constraints left from `plan_partial` are block alignment and
+    the >= 1 recomputed token rule (the last prompt position's logits
+    seed the first sampled token; the cache does not store them). The
+    spliced prefix simply moves the staged cursor forward: fewer prefill
+    chunks, identical flip contract.
+    """
+    p = min(hit_tokens, true_len - 1)
+    return p - p % block_tokens
+
+
 class PrefixCache:
     """Host-side radix tree over block-granular token prefixes.
 

@@ -319,6 +319,23 @@ def main(argv=None) -> None:
                         help="shared-prefix cache block budget (16 "
                         "tokens/block; LRU eviction, blocks referenced "
                         "by live slots are never freed)")
+    parser.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                        help="paged engine fused stall-free admission: "
+                        "stage arriving prompts into the decode state "
+                        "and prefill this many tokens per megastep scan "
+                        "iteration INSIDE the decode program, so "
+                        "admission never pauses the decode train "
+                        "(decode_stalled_tokens stays 0; admission "
+                        "latency is bounded by scan iterations, not "
+                        "prompt length). 0 = sequential admission; "
+                        "ignored without --paged")
+    parser.add_argument("--draft-source", default="prompt_lookup",
+                        choices=["prompt_lookup", "ngram"],
+                        help="speculative draft source (with "
+                        "--spec-tokens): prompt_lookup = most-recent "
+                        "n-gram continuation; ngram = per-slot "
+                        "modal-continuation table (paged only, higher "
+                        "acceptance at temperature>0)")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
                              "ephemeral); omit to disable")
@@ -367,6 +384,8 @@ def main(argv=None) -> None:
             "inflight": t.inflight,
             "prefix_cache": t.prefix_cache,
             "prefix_cache_blocks": t.prefix_cache_blocks,
+            "prefill_chunk_tokens": t.prefill_chunk_tokens,
+            "draft_source": t.draft_source,
             "auth_key_file": t.auth_key_file,
             # store_true flags merge the same way: presence in argv is what
             # marks them explicit, so the file fills only absent ones.
@@ -428,6 +447,7 @@ def main(argv=None) -> None:
         quant=args.quant,
         kv_quant=args.kv_quant,
         spec_tokens=args.spec_tokens,
+        draft_source=args.draft_source,
     )
     if args.paged:
         # --max-batch bounds concurrency in both modes: it is the decode
@@ -442,11 +462,15 @@ def main(argv=None) -> None:
                              megastep=args.megastep,
                              megastep_max=args.megastep_max,
                              prefix_cache=args.prefix_cache,
-                             prefix_cache_blocks=args.prefix_cache_blocks)
+                             prefix_cache_blocks=args.prefix_cache_blocks,
+                             prefill_chunk_tokens=args.prefill_chunk_tokens)
     else:
         if args.prefix_cache:
             log.warning("--prefix-cache applies to the paged engine only; "
                         "ignored without --paged")
+        if args.prefill_chunk_tokens:
+            log.warning("--prefill-chunk-tokens applies to the paged "
+                        "engine only; ignored without --paged")
         engine = TutoringEngine(config)
     if not args.no_warmup:
         secs = (engine.warmup() if args.paged

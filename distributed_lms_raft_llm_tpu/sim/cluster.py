@@ -390,6 +390,10 @@ class SimCluster:
                     _dc.replace(config, length_buckets=(16, 32)),
                     slots=4, chunk=4, prefix_cache=True,
                     prefix_cache_blocks=128, prefix_block_tokens=8,
+                    # Fused stall-free admission, like cluster.toml: the
+                    # soak exercises staged chunked prefill under real
+                    # diurnal churn (decode_stalled_tokens stays 0).
+                    prefill_chunk_tokens=8,
                 )
                 queue = PagedQueue(engine, metrics=metrics, max_queue=64)
             else:

@@ -237,6 +237,20 @@ HOST_DISPATCHES_PER_TOKEN = gauge(
     "host program dispatches paid per emitted token on the paged engine "
     "(cumulative ratio; the megastep exists to shrink it)",
 )
+PREFILL_STALL_MS = counter(
+    "prefill_stall_ms",
+    "host wall milliseconds the paged decode train spent blocked on "
+    "sequential admission (prefill dispatches + the first-token sync "
+    "while live slots waited); 0 by construction under fused staged "
+    "admission (prefill_chunk_tokens > 0)",
+)
+DECODE_STALLED_TOKENS = counter(
+    "decode_stalled_tokens",
+    "proxy decode tokens the live slots gave up to blocking sequential "
+    "admission (live slots x chunk per admission prefill that paused "
+    "the train); 0 by construction under fused staged admission — the "
+    "fused-prefill before/after number",
+)
 PREFIX_CACHE_HIT_TOKENS = counter(
     "prefix_cache_hit_tokens",
     "prompt tokens whose KV was spliced from the shared-prefix radix "
@@ -310,6 +324,12 @@ ENGINE_PROG_GROW = histogram(
     "paged-engine _grow program dispatch wall time (cache width "
     "transition)",
 )
+ENGINE_PROG_STAGE = histogram(
+    "engine_prog_stage",
+    "paged-engine _stage program dispatch wall time (fused admission: "
+    "arming a slot's staged prompt; the prefill itself runs inside the "
+    "megastep scan)",
+)
 ENGINE_PROG_GENERATE = histogram(
     "engine_prog_generate",
     "bucketed-engine generate dispatch wall time (one grouped device "
@@ -326,6 +346,7 @@ ENGINE_PROGRAM_HISTOGRAMS: Dict[str, str] = {
     "step": ENGINE_PROG_STEP,
     "megastep": ENGINE_PROG_MEGASTEP,
     "grow": ENGINE_PROG_GROW,
+    "stage": ENGINE_PROG_STAGE,
     "generate": ENGINE_PROG_GENERATE,
 }
 

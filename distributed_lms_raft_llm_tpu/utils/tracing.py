@@ -444,7 +444,17 @@ class Tracer:
             return
         rec.pins.discard(pin)
         if not rec.pins and trace_id not in self._ring:
+            # Re-enter the ring at the OLD end and enforce the bound: an
+            # ex-pin (displaced exemplar, aged-out flagged FIFO entry) is
+            # ordinary retention again and must not outrank genuinely
+            # newer traces — appending it as newest let a displaced
+            # exemplar linger past ring_size fresher records (the
+            # "retained but neither pinned nor recent" hole
+            # tests/test_tracing.py::test_ring_evicts_oldest_unpinned
+            # catches under load-jittered durations).
             self._ring[trace_id] = None
+            self._ring.move_to_end(trace_id, last=False)
+            self._evict()
 
     def _pin_if_anomalous(self, rec: _TraceRecord) -> None:  # guarded-by: _lock
         if not (rec.flags - {"truncated"}) or self.flagged_max == 0:

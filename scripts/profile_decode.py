@@ -232,7 +232,8 @@ def profile_megastep(args) -> None:
             eng.submit(p)
         eng.drain()
         wall = time.monotonic() - t0
-        dispatches, tokens, dead = eng.pop_dispatch_stats()
+        dispatches, tokens, dead, _stall_ms, _stalled = \
+            eng.pop_dispatch_stats()
         per_prog: dict = {}
         for pname, _start, wall_s in eng.pop_program_times():
             n, tot = per_prog.get(pname, (0, 0.0))

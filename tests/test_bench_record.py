@@ -75,6 +75,51 @@ def test_bench_shared_prefix_record_smoke():
     assert out["cold_hit_rate"] < 0.1
 
 
+def test_bench_paged_fused_admission_record_smoke():
+    """bench.py --prefill-chunk-tokens: the record carries the fused
+    knob and the stall-free before/after fields (zero by construction
+    with fusion on)."""
+    from bench import bench_paged
+
+    out = bench_paged(
+        model="tiny", batch=2, greedy=True, chunk=2, megastep=2,
+        megastep_max=2, max_new=8, rounds=1, prompt_len=8,
+        length_buckets=(8, 16), prefill_chunk_tokens=4,
+    )
+    assert out["tokens_per_sec_per_chip"] > 0
+    assert out["prefill_chunk_tokens"] == 4
+    assert out["prefill_stall_ms"] == 0
+    assert out["decode_stalled_tokens"] == 0
+
+
+def test_bench_sweep_grid_smoke():
+    """bench.py --sweep: one BENCH-schema JSON record per
+    (slots, inflight, megastep) grid point, each carrying the megastep
+    knobs and the admission-stall fields — the round-6 grid runner the
+    next chip-attached session executes verbatim (BENCH_NOTES round 6)."""
+    from bench import bench_sweep
+
+    grid = bench_sweep(
+        model="tiny", slots_grid=(2,), inflight_grid=(1, 2),
+        megastep_grid=(2,), greedy=True, chunk=2, max_new=8,
+        rounds=1, prompt_len=8, length_buckets=(8, 16),
+        prefill_chunk_tokens=4,
+    )
+    assert len(grid) == 2
+    metrics = {r["metric"] for r in grid}
+    assert "paged_sweep_slots2_inflight1_mega2" in metrics
+    assert "paged_sweep_slots2_inflight2_mega2" in metrics
+    for r in grid:
+        assert r["unit"] == "tokens/sec/chip"
+        assert r["value"] > 0
+        assert r["slots"] == 2
+        assert r["inflight"] in (1, 2)
+        assert r["megastep"] == 2
+        assert r["prefill_chunk_tokens"] == 4
+        assert r["decode_stalled_tokens"] == 0
+        assert r["host_dispatches_per_token"] > 0
+
+
 def test_bench_paged_carries_prefix_knob_and_hit_rate():
     from bench import bench_paged
 

@@ -298,6 +298,50 @@ def test_donating_a_shared_prefix_block_fails_lint():
         "donation-safety"
 
 
+def test_uninventoried_fused_admission_jit_entry_fails_lint():
+    """PR-12 acceptance pin: the fused-admission program family
+    (_stage/_stage_block) is inventoried like every other jit entry — a
+    new staged-admission program added without regenerating the manifest
+    must fail program-inventory."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.program_inventory import (
+        ProgramInventoryRule,
+    )
+
+    project = _project_with_patch(PAGED, (
+        "self._stage = jax.jit(",
+        "self._rogue_stage = jax.jit(\n"
+        "            partial(_stage_program), donate_argnums=(0,),\n"
+        "        )\n"
+        "        self._stage = jax.jit(",
+    ))
+    findings = [
+        f for f in ProgramInventoryRule().check_project(project)
+        if "uninventoried" in f.message
+    ]
+    assert findings, "a staged-admission jit entry missing from the " \
+        "manifest must fail program-inventory"
+
+
+def test_host_readback_in_staged_reap_fails_lint():
+    """PR-12 acceptance pin: the staged-admission reap learns flips from
+    planes read INSIDE `with intended_transfer():` — the one sanctioned
+    sync point. A host readback of the flipped plane outside it (what
+    reverting the batched-reap design to an eager per-flip sync would
+    look like) must fail no-host-sync-in-dispatch."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.host_sync import (
+        HostSyncInDispatchRule,
+    )
+
+    project = _project_with_patch(PAGED, (
+        "                col = (np.zeros((k_axis,), bool) if flipped is None\n"
+        "                       else flipped[:, slot])",
+        "                col = np.asarray(flipped_dev)[:, slot]",
+    ))
+    findings = HostSyncInDispatchRule().check(project.sources[PAGED])
+    assert findings, "a host readback in the staged-admission reap " \
+        "outside intended_transfer() must fail no-host-sync-in-dispatch"
+
+
 def test_uninventoried_jit_entry_fails_lint():
     from distributed_lms_raft_llm_tpu.analysis.rules.program_inventory import (
         ProgramInventoryRule,

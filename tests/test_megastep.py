@@ -111,6 +111,33 @@ def test_controller_holds_amortization_under_saturation():
     assert next_megastep_k(2, ladder, pending=1, slack_chunks=4) == 4
 
 
+def test_controller_fused_floor_is_second_rung():
+    """Satellite pin (staged chunked admission): with fusion on, a
+    boundary's only admission value is handing a freed slot to the
+    stager — the prefill itself drains through scan iterations — so the
+    pending-queue shrink must NOT reach the K=1 chunk loop. K stays >= 2
+    under a non-empty pending queue at any slack, while the slack cap
+    still applies above the floor."""
+    ladder = [1, 2, 4, 8]
+    # The sequential path drops to 1 at these points; fused holds 2.
+    assert next_megastep_k(8, ladder, pending=1, slack_chunks=1,
+                           fused=True) == 2
+    assert next_megastep_k(8, ladder, pending=3, slack_chunks=0,
+                           fused=True) == 2
+    assert next_megastep_k(8, ladder, pending=1, slack_chunks=None,
+                           fused=True) == 2
+    # Above the floor the slack/horizon math is unchanged.
+    assert next_megastep_k(8, ladder, pending=1, slack_chunks=5,
+                           fused=True) == 4
+    assert next_megastep_k(1, ladder, pending=16, slack_chunks=64,
+                           fused=True) == 8
+    # Idle growth identical; a [1] ladder (megastep disabled) still
+    # returns its only rung.
+    assert next_megastep_k(1, ladder, pending=0, fused=True) == 2
+    assert next_megastep_k(1, [1], pending=5, slack_chunks=0,
+                           fused=True) == 1
+
+
 def test_controller_grows_toward_max_when_idle():
     ladder = [1, 2, 4, 8]
     assert next_megastep_k(1, ladder, pending=0) == 2
@@ -261,7 +288,8 @@ def test_step_dispatches_per_token_reduced_4x_at_k4():
                           megastep=megastep, megastep_max=megastep)
         eng.submit(prompt)
         eng.drain()
-        dispatches, tokens, _dead = eng.pop_dispatch_stats()
+        dispatches, tokens, _dead, _stall, _stalled = \
+            eng.pop_dispatch_stats()
         steps = sum(
             1 for name, _, _ in eng.pop_program_times()
             if name in ("step", "megastep")
@@ -297,12 +325,18 @@ def test_dead_lane_account_matches_first_principles():
     cache = cache._replace(length=jnp.full((s_slots,), t0, jnp.int32))
     transcript = jnp.zeros((s_slots, width), jnp.int32)
     transcript = transcript.at[:, :t0].set(ids)
+    key_shape = jax.random.key_data(jax.random.key(0)).shape
     state = SlotState(
         cache=cache,
         tok=ids[:, -1],
         active=jnp.ones((s_slots,), bool),
         seen=jnp.zeros((s_slots, cfg.vocab_size), bool),
         transcript=transcript,
+        staged=jnp.zeros((s_slots,), bool),
+        stage_cursor=jnp.zeros((s_slots,), jnp.int32),
+        stage_len=jnp.ones((s_slots,), jnp.int32),
+        stage_seq=jnp.zeros((s_slots,), jnp.int32),
+        stage_rng=jnp.zeros((s_slots,) + key_shape, jnp.uint32),
     )
     statics = dict(cfg=cfg, sampling=sampling, pad_id=0, model=family,
                    chunk=chunk)
@@ -340,7 +374,7 @@ def test_k1_dispatches_account_no_dead_lanes():
     for p in PROMPTS[:2]:
         eng.submit(p)
     eng.drain()
-    _, _, dead = eng.pop_dispatch_stats()
+    _, _, dead, _, _ = eng.pop_dispatch_stats()
     assert dead == 0
 
 

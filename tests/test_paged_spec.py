@@ -171,12 +171,18 @@ def test_first_window_token_matches_plain_step_distribution():
     transcript = jnp.zeros((s_slots, width), jnp.int32)
     transcript = transcript.at[:, :t0].set(ids)
     transcript = transcript.at[:, t0].set(pending)
+    key_shape = jax.random.key_data(jax.random.key(0)).shape
     state = SlotState(
         cache=cache,
         tok=jnp.full((s_slots,), pending, jnp.int32),
         active=jnp.ones((s_slots,), bool),
         seen=seen,
         transcript=transcript,
+        staged=jnp.zeros((s_slots,), bool),
+        stage_cursor=jnp.zeros((s_slots,), jnp.int32),
+        stage_len=jnp.ones((s_slots,), jnp.int32),
+        stage_seq=jnp.zeros((s_slots,), jnp.int32),
+        stage_rng=jnp.zeros((s_slots,) + key_shape, jnp.uint32),
     )
 
     statics = dict(cfg=cfg, sampling=sampling, eos_id=-1, pad_id=-1,
