@@ -41,7 +41,18 @@ __all__ = [
     "ModuleInfo",
     "Project",
     "ProjectRule",
+    "EGRESS_ROOT_MODULES",
 ]
+
+# Router/pool egress modules whose async functions count as rule roots
+# for the request-path project rules (deadline-flow, trace-propagation):
+# they run per-request behind instance-attribute calls
+# (`self.pool.forward(...)`) the call graph cannot resolve into an edge
+# from a Servicer handler. ONE shared list so adding the next egress
+# module cannot silently update one rule but not the other.
+EGRESS_ROOT_MODULES = (
+    "distributed_lms_raft_llm_tpu/lms/tutoring_pool.py",
+)
 
 
 class FunctionInfo:

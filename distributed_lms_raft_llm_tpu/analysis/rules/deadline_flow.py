@@ -25,7 +25,12 @@ Mechanics (analysis/project.py):
 - the finding fires on `timeout=<int|float literal>` at such a call. A
   timeout *expression* (`deadline.timeout(cap=...)`, `max(floor, ...)`)
   is the fix shape and never flags, so the rule cannot pester correct
-  code into suppressions.
+  code into suppressions;
+- the async functions of the router/pool egress modules
+  (`DEFAULT_EGRESS_ROOTS`, e.g. `lms/tutoring_pool.py`) are roots in
+  their own right: they run per-request behind `self.pool.forward(...)`
+  attribute calls the call graph cannot resolve, and they hold the
+  hottest timeout in the system (the hedged tutoring forward).
 
 Raft-internal RPC timing (`raft/grpc_transport.py`) is deliberately out
 of scope: heartbeat-scale protocol timeouts are a consensus-liveness
@@ -38,13 +43,27 @@ import ast
 from typing import List, Sequence, Tuple
 
 from ..core import Finding, register
-from ..project import Project, ProjectRule
+from ..project import (
+    EGRESS_ROOT_MODULES,
+    Project,
+    ProjectRule,
+)
 
 # Request-path modules: where client deadline budgets live.
 DEFAULT_WATCH = (
     "distributed_lms_raft_llm_tpu/lms/",
     "distributed_lms_raft_llm_tpu/serving/",
 )
+
+# Router/pool egress modules: their async functions run per-request but
+# are invoked through instance attributes (`self.pool.forward(...)`),
+# which the call graph's heuristics cannot resolve into an edge from the
+# Servicer handler — so they are treated as roots in their own right.
+# Without this, the fleet router's stub egress (the hottest timeout in
+# the system) would silently fall out of the rule's reachable set.
+# Shared with trace-propagation (analysis/project.py) so the two rules
+# cannot drift.
+DEFAULT_EGRESS_ROOTS = EGRESS_ROOT_MODULES
 
 
 def _literal_timeout(call: ast.Call) -> Tuple[bool, object]:
@@ -74,11 +93,17 @@ class DeadlineFlowRule(ProjectRule):
         "(utils/resilience.Deadline.timeout)"
     )
 
-    def __init__(self, watch_prefixes: Sequence[str] = DEFAULT_WATCH):
+    def __init__(self, watch_prefixes: Sequence[str] = DEFAULT_WATCH,
+                 egress_roots: Sequence[str] = DEFAULT_EGRESS_ROOTS):
         self.watch_prefixes = tuple(watch_prefixes)
+        self.egress_roots = tuple(egress_roots)
 
     def check_project(self, project: Project) -> List[Finding]:
         roots = project.handler_roots() | project.address_taken
+        roots |= {
+            fn.qname for fn in project.functions_in(self.egress_roots)
+            if fn.is_async
+        }
         reachable = project.reachable(roots)
         findings: List[Finding] = []
         seen = set()

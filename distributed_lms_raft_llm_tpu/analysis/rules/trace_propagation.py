@@ -26,7 +26,15 @@ Mechanics (analysis/project.py, shared with deadline-flow):
   CamelCase — the proto naming convention separating wire RPCs
   (`FetchFile`, `GetLLMAnswer`) from snake_case helpers; the await
   requirement keeps protobuf constructors (`lms_pb2.FetchFileRequest`,
-  also CamelCase, never awaited) out of scope;
+  also CamelCase, never awaited) out of scope. A second shape is also
+  matched: a CamelCase call carrying a `timeout=` keyword whose handle
+  is awaited *later* (the fleet router holds the call object to read
+  the `x-served-by` response trailer) — constructors never pass
+  `timeout=`, so they stay out of scope;
+- the async functions of the router/pool egress modules
+  (`DEFAULT_EGRESS_ROOTS`, e.g. `lms/tutoring_pool.py`) are roots in
+  their own right: they run per-request behind `self.pool.forward(...)`
+  attribute calls the call graph cannot resolve;
 - the finding fires when the call has no `metadata=` keyword, or one
   whose value is not a direct `trace_metadata(...)` call. Wrapping the
   existing expression (`metadata=trace_metadata(deadline.to_metadata())`)
@@ -44,13 +52,24 @@ import ast
 from typing import List, Optional, Sequence
 
 from ..core import Finding, register
-from ..project import Project, ProjectRule
+from ..project import (
+    EGRESS_ROOT_MODULES,
+    Project,
+    ProjectRule,
+)
 
 # Request-path modules: where request-scoped trace context lives.
 DEFAULT_WATCH = (
     "distributed_lms_raft_llm_tpu/lms/",
     "distributed_lms_raft_llm_tpu/serving/",
 )
+
+# Router/pool egress modules: their async functions are per-request
+# egress invoked through instance attributes (`self.pool.forward`),
+# which the call graph cannot resolve — treat them as roots so the fleet
+# router's own stub egress is held to the same contract (see
+# deadline_flow.DEFAULT_EGRESS_ROOTS).
+DEFAULT_EGRESS_ROOTS = EGRESS_ROOT_MODULES
 
 # The sanctioned metadata-building wrapper (utils/tracing.py).
 WRAPPER = "trace_metadata"
@@ -96,11 +115,17 @@ class TracePropagationRule(ProjectRule):
         "an orphan fragment; wrap the existing metadata expression"
     )
 
-    def __init__(self, watch_prefixes: Sequence[str] = DEFAULT_WATCH):
+    def __init__(self, watch_prefixes: Sequence[str] = DEFAULT_WATCH,
+                 egress_roots: Sequence[str] = DEFAULT_EGRESS_ROOTS):
         self.watch_prefixes = tuple(watch_prefixes)
+        self.egress_roots = tuple(egress_roots)
 
     def check_project(self, project: Project) -> List[Finding]:
         roots = project.handler_roots() | project.address_taken
+        roots |= {
+            fn.qname for fn in project.functions_in(self.egress_roots)
+            if fn.is_async
+        }
         reachable = project.reachable(roots)
         findings: List[Finding] = []
         seen = set()
@@ -108,9 +133,21 @@ class TracePropagationRule(ProjectRule):
             if fn.qname not in reachable:
                 continue
             for node in ast.walk(fn.node):
-                if not isinstance(node, ast.Await):
-                    continue
-                call = _awaited_stub_egress(node)
+                # Two egress shapes: `await stub.Rpc(...)` (the common
+                # case), and a stub call whose handle is awaited later
+                # so the caller can read trailing metadata — recognized
+                # by its `timeout=` keyword, which protobuf constructors
+                # (the other CamelCase calls) never carry.
+                call = None
+                if isinstance(node, ast.Await):
+                    call = _awaited_stub_egress(node)
+                elif isinstance(node, ast.Call):
+                    func = node.func
+                    if (isinstance(func, ast.Attribute)
+                            and func.attr[:1].isupper()
+                            and any(kw.arg == "timeout"
+                                    for kw in node.keywords)):
+                        call = node
                 if call is None:
                     continue
                 rpc = call.func.attr  # type: ignore[union-attr]

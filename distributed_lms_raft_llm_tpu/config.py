@@ -126,6 +126,58 @@ class TutoringConfig:
 
 
 @dataclasses.dataclass
+class TutoringFleetConfig:
+    """[tutoring_fleet] — cache-affinity routing across N tutoring nodes
+    (lms/tutoring_pool.py). One section because the knobs compose into
+    one policy: the ring places same-course traffic on the node already
+    holding its radix prefix blocks, the spill/hedge knobs bound the
+    tail when that node is slow or down, and the drain/warm-up knobs
+    govern elastic membership without cold-starting every course's
+    cache. Empty `addresses` = a one-node fleet at [tutoring].address
+    (full back-compat)."""
+
+    addresses: List[str] = dataclasses.field(default_factory=list)
+    # Optional per-node /healthz endpoints (host:port of each node's
+    # --metrics-port plane), same order as `addresses`: enables the
+    # router's health poller (queue-depth signal, drain-driven ejection
+    # and rejoin, half-open breaker recovery probes).
+    health_addresses: List[str] = dataclasses.field(default_factory=list)
+    hedge_after_s: float = 0.35     # hedge the forward to the second
+    #                                 choice after this silence; 0 = off
+    queue_spill_depth: int = 8      # spill when the affinity node's
+    #                                 serving queue is deeper than this
+    #                                 (and the second choice's is not)
+    warmup_s: float = 5.0           # rejoin warm-up ramp length
+    warmup_weight: float = 0.25     # initial key-share weight of a
+    #                                 rejoined/added node (ramps to 1.0
+    #                                 over warmup_s)
+    health_poll_s: float = 1.0      # router health-poll cadence
+
+    def __post_init__(self) -> None:
+        if self.health_addresses and len(self.health_addresses) != len(
+            self.addresses
+        ):
+            raise ValueError(
+                "[tutoring_fleet] health_addresses must be empty or "
+                "match addresses one-to-one"
+            )
+        if self.hedge_after_s < 0 or self.health_poll_s <= 0:
+            raise ValueError(
+                "[tutoring_fleet] needs hedge_after_s >= 0 and "
+                "health_poll_s > 0"
+            )
+        if not 0.0 < self.warmup_weight <= 1.0 or self.warmup_s < 0:
+            raise ValueError(
+                "[tutoring_fleet] needs 0 < warmup_weight <= 1 and "
+                "warmup_s >= 0"
+            )
+        if self.queue_spill_depth < 1:
+            raise ValueError(
+                "[tutoring_fleet] queue_spill_depth must be >= 1"
+            )
+
+
+@dataclasses.dataclass
 class GateConfig:
     """[gate] — the BERT relevance gate on the LMS leader."""
 
@@ -235,6 +287,13 @@ class SimConfig:
     #                                assignment context (the shared-prefix
     #                                cache's target workload); 1 = all
     #                                traffic on course0
+    tutoring_nodes: int = 1       # tutoring fleet size: N in-process
+    #                               tutoring nodes behind the LMS
+    #                               routing tier (cache-affinity ring,
+    #                               spill, hedging); > 1 adds the fleet
+    #                               drills to the operations schedule
+    #                               (kill-one-of-N blackout,
+    #                               drain-and-rejoin, autoscale)
     tutoring_engine: str = "echo"  # "echo" (wire-complete stand-in),
     #                                "tiny" (real JAX engine, tier-2 soak),
     #                                or "tiny-paged" (real paged engine +
@@ -268,6 +327,8 @@ class SimConfig:
             raise ValueError("[sim] needs courses/instructors >= 1")
         if self.base_rate <= 0:
             raise ValueError("[sim] base_rate must be > 0")
+        if self.tutoring_nodes < 1:
+            raise ValueError("[sim] tutoring_nodes must be >= 1")
         if not 0.0 <= self.course_concentration <= 1.0:
             raise ValueError("[sim] course_concentration must be in [0, 1]")
 
@@ -346,6 +407,9 @@ class TelemetryConfig:
 class AppConfig:
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     tutoring: TutoringConfig = dataclasses.field(default_factory=TutoringConfig)
+    tutoring_fleet: TutoringFleetConfig = dataclasses.field(
+        default_factory=TutoringFleetConfig
+    )
     sampling: SamplingConfig = dataclasses.field(default_factory=SamplingConfig)
     gate: GateConfig = dataclasses.field(default_factory=GateConfig)
     resilience: ResilienceConfig = dataclasses.field(
@@ -378,9 +442,9 @@ def load_config(path: str) -> AppConfig:
     """Parse a TOML deployment file into an AppConfig (strict keys)."""
     with open(path, "rb") as fh:
         raw = tomllib.load(fh)
-    unknown = set(raw) - {"cluster", "tutoring", "sampling", "gate",
-                          "resilience", "storage", "sim", "tracing",
-                          "telemetry"}
+    unknown = set(raw) - {"cluster", "tutoring", "tutoring_fleet",
+                          "sampling", "gate", "resilience", "storage",
+                          "sim", "tracing", "telemetry"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -394,6 +458,9 @@ def load_config(path: str) -> AppConfig:
         cluster=_build(ClusterConfig, cluster_tbl, "cluster"),
         tutoring=_build(TutoringConfig, dict(raw.get("tutoring", {})),
                         "tutoring"),
+        tutoring_fleet=_build(TutoringFleetConfig,
+                              dict(raw.get("tutoring_fleet", {})),
+                              "tutoring_fleet"),
         sampling=_build(SamplingConfig, dict(raw.get("sampling", {})),
                         "sampling"),
         gate=_build(GateConfig, dict(raw.get("gate", {})), "gate"),

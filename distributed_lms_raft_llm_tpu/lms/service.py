@@ -14,7 +14,9 @@ fixed:
 - uploads replicate leader→followers via `FileTransferService.SendFile`
   with replace-not-append semantics and path confinement (D5);
 - the BERT gate is a long-lived engine object, not a per-request model load
-  (D4), and the tutoring channel is dialed once.
+  (D4), and tutoring queries go through a long-lived routing pool
+  (lms/tutoring_pool.py: cache-affinity ring over N tutoring nodes,
+  per-node breakers, spill, hedged sends) instead of a per-request dial.
 
 Read RPCs are linearizable by default: each one passes a read fence
 (`raft.RaftNode.read_barrier`) that proves current leadership before the
@@ -35,9 +37,9 @@ import grpc
 
 from ..proto import lms_pb2, rpc
 from ..raft import NotLeader, TransferInFlight, encode_command
-from ..utils import metrics_registry, pdf
+from ..utils import pdf
 from ..utils.auth import sign_query
-from ..utils.faults import FaultInjected, FaultInjector
+from ..utils.faults import FaultInjector
 from ..utils.metrics import Metrics
 from ..utils.resilience import (
     CircuitBreaker,
@@ -53,6 +55,7 @@ from ..utils.tracing import (
 )
 from .persistence import BlobStore
 from .state import LMSState, hash_password
+from .tutoring_pool import TutoringPool, TutoringUnavailable
 
 log = logging.getLogger(__name__)
 
@@ -78,6 +81,7 @@ class LMSServicer(rpc.LMSServicer):
         tutoring_timeout_s: float = 120.0,
         deadline_floor_s: float = 0.25,
         blob_fetch_timeout_s: float = 5.0,
+        tutoring_pool: Optional[TutoringPool] = None,
     ):
         self.node = node
         self.state = state
@@ -85,21 +89,30 @@ class LMSServicer(rpc.LMSServicer):
         self.linearizable_reads = linearizable_reads
         self.gate = gate
         self.metrics = metrics or Metrics()
-        self._tutoring_address = tutoring_address
         self._tutoring_auth_key = tutoring_auth_key
-        self._tutoring_channel: Optional[grpc.aio.Channel] = None
-        self._tutoring_stub = None
-        # Resilience around the tutoring forward: the breaker turns a dead
-        # tutoring node into O(1) degraded answers (instructor queue)
-        # instead of per-request stacked timeouts; the injector lets chaos
-        # tests fault this hop over real gRPC (admin: POST /admin/faults).
-        # The servicer owns the transition observer either way — callers
-        # supply thresholds, not logging/metrics plumbing.
-        self.tutoring_breaker = tutoring_breaker or CircuitBreaker()
-        self.tutoring_breaker.set_state_change_callback(
-            self._on_breaker_change
+        # The tutoring routing tier (lms/tutoring_pool.py): per-node
+        # breakers turn dead fleet members into spills (and, with every
+        # node down, O(1) degraded answers) instead of stacked timeouts;
+        # the injector faults each node's hop over real gRPC (admin:
+        # POST /admin/faults, per-node target "tutoring:<i>"). A bare
+        # `tutoring_address` still works: it becomes a one-node fleet,
+        # with `tutoring_breaker` as that node's breaker.
+        if tutoring_pool is None:
+            tutoring_pool = TutoringPool(
+                [tutoring_address] if tutoring_address else [],
+                metrics=self.metrics,
+                fault_injector=fault_injector,
+                breakers=[tutoring_breaker] if tutoring_breaker else None,
+                timeout_s=tutoring_timeout_s,
+                deadline_floor_s=deadline_floor_s,
+            )
+        self.pool = tutoring_pool
+        # Back-compat handle: the (affinity/sole) node's breaker, still
+        # surfaced under the `tutoring_breaker` /healthz key.
+        self.tutoring_breaker = (
+            self.pool.nodes[0].breaker if self.pool.configured
+            else (tutoring_breaker or CircuitBreaker())
         )
-        self.faults = fault_injector
         self._tutoring_timeout_s = tutoring_timeout_s
         self._deadline_floor_s = deadline_floor_s
         self._blob_fetch_timeout_s = blob_fetch_timeout_s
@@ -176,26 +189,6 @@ class LMSServicer(rpc.LMSServicer):
                 grpc.StatusCode.UNAVAILABLE,
                 f"not the leader for reads ({e}); re-resolve and retry",
             )
-
-    def _tutoring(self):
-        if self._tutoring_stub is None:
-            if not self._tutoring_address:
-                return None
-            self._tutoring_channel = grpc.aio.insecure_channel(
-                self._tutoring_address
-            )
-            self._tutoring_stub = rpc.TutoringStub(self._tutoring_channel)
-        return self._tutoring_stub
-
-    def _on_breaker_change(self, old: str, new: str) -> None:
-        log.warning("tutoring breaker %s -> %s", old, new)
-        # Transition counters come from the registry's state mapping, so
-        # the series stay declared (metrics-registry lint rule) even
-        # though the state arrives at runtime.
-        self.metrics.inc(metrics_registry.BREAKER_TRANSITION_COUNTERS[new])
-        self.metrics.set_gauge(
-            "tutoring_breaker_state", CircuitBreaker._STATE_CODES[new]
-        )
 
     async def _degraded_answer(self, username: str, query: str, reason: str,
                                request_id: Optional[str] = None):
@@ -638,8 +631,7 @@ class LMSServicer(rpc.LMSServicer):
                             "your instructor instead."
                         ),
                     )
-            stub = self._tutoring()
-            if stub is None:
+            if not self.pool.configured:
                 return lms_pb2.QueryResponse(
                     success=False, response="Tutoring service not configured."
                 )
@@ -662,12 +654,6 @@ class LMSServicer(rpc.LMSServicer):
                     username, request.query, "deadline budget exhausted",
                     request_id=client_rid,
                 )
-            if not self.tutoring_breaker.allow():
-                self.metrics.inc("tutoring_breaker_rejections")
-                return await self._degraded_answer(
-                    username, request.query, "circuit open",
-                    request_id=client_rid,
-                )
             # With a shared key configured, the forwarded query carries an
             # HMAC ticket in the token field; the tutoring node answers only
             # ticketed queries, closing the direct-dial gate bypass.
@@ -676,73 +662,37 @@ class LMSServicer(rpc.LMSServicer):
                 if self._tutoring_auth_key
                 else request.token
             )
+            # The fleet router (lms/tutoring_pool.py) owns everything
+            # between here and the wire: cache-affinity placement, spill
+            # past open breakers / deep queues / short budgets, hedged
+            # sends, per-node chaos (faults target "tutoring:<i>"), and
+            # the per-attempt breaker accounting.
             try:
-                plan = (await self.faults.apply_pre("tutoring")
-                        if self.faults is not None else None)
-                if deadline is not None:
-                    # Re-read the live budget: an injected delay (or any
-                    # await above) has been eating it since the snapshot,
-                    # and the forward's timeout must not overshoot what
-                    # the client will actually wait.
-                    budget = deadline.timeout(cap=self._tutoring_timeout_s)
-                # trace_metadata called INSIDE the span: the forwarded
-                # x-trace-context carries the forward span's id, so the
-                # tutoring node's fragment grafts under it on the
-                # waterfall.
-                with get_tracer().span("tutoring.forward"):
-                    answer = await stub.GetLLMAnswer(
-                        lms_pb2.QueryRequest(token=fwd_token,
-                                             query=request.query),
-                        timeout=max(0.001, budget - self._deadline_floor_s)
-                        if deadline is not None else budget,
-                        metadata=trace_metadata(
-                            deadline.to_metadata()
-                            if deadline is not None else None),
+                answer, _served = await self.pool.forward(
+                    request.query, fwd_token, deadline=deadline
+                )
+            except TutoringUnavailable as e:
+                if e.kind == "breaker":
+                    self.metrics.inc("tutoring_breaker_rejections")
+                    return await self._degraded_answer(
+                        username, request.query, "circuit open",
+                        request_id=client_rid,
                     )
-                if plan is not None and plan.duplicate:
-                    # Deliver the query twice, like FaultyTransport does
-                    # for Raft RPCs: the hop is a pure read/compute, so a
-                    # duplicate must only cost compute, never change the
-                    # answer's success — verified over real gRPC by the
-                    # chaos soak. Counted so snapshot()'s injected_total
-                    # matches faults that actually happened (ROADMAP
-                    # item b: this used to be a silent no-op that still
-                    # counted as injected). The re-send failing (e.g. the
-                    # remaining budget is gone) must not discard the
-                    # successful first answer, so it has its own handler.
-                    self.metrics.inc("tutoring_duplicates")
-                    if deadline is not None:
-                        budget = deadline.timeout(cap=self._tutoring_timeout_s)
-                    try:
-                        with get_tracer().span("tutoring.forward",
-                                               duplicate=True):
-                            answer = await stub.GetLLMAnswer(
-                                lms_pb2.QueryRequest(
-                                    token=fwd_token, query=request.query
-                                ),
-                                timeout=max(0.001,
-                                            budget - self._deadline_floor_s)
-                                if deadline is not None else budget,
-                                metadata=trace_metadata(
-                                    deadline.to_metadata()
-                                    if deadline is not None else None),
-                            )
-                    except grpc.RpcError as e:
-                        log.info("duplicate delivery failed (%s); keeping "
-                                 "the first answer", e.code())
-                if plan is not None and plan.error:
-                    raise FaultInjected("injected response loss <- tutoring")
-            except (grpc.RpcError, FaultInjected) as e:
-                code = e.code() if isinstance(e, grpc.RpcError) else None
-                log.warning("tutoring RPC failed: %s", code or e)
-                self.metrics.inc("tutoring_failures")
-                self.tutoring_breaker.record_failure()
+                if e.kind == "budget":
+                    self.metrics.inc("tutoring_budget_exhausted")
+                    cur = get_tracer().current()
+                    if cur is not None:
+                        cur.flag(FLAG_DEADLINE)
+                    return await self._degraded_answer(
+                        username, request.query,
+                        "deadline budget exhausted",
+                        request_id=client_rid,
+                    )
+                log.warning("tutoring fleet unavailable: %s", e)
                 return await self._degraded_answer(
-                    username, request.query,
-                    f"tutoring RPC failed ({code or e})",
+                    username, request.query, str(e),
                     request_id=client_rid,
                 )
-            self.tutoring_breaker.record_success()
         return answer
 
     @traced_grpc_handler("lms.WhoIsLeader")

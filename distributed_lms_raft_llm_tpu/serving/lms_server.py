@@ -26,12 +26,14 @@ import asyncio
 import json
 import logging
 import os
+import urllib.parse
 from typing import Dict
 
 import grpc
 
 from ..lms.node import LMSNode
 from ..lms.service import FileTransferServicer, LMSServicer
+from ..lms.tutoring_pool import TutoringPool
 from ..proto import rpc
 from ..raft import RaftConfig
 from ..raft.grpc_transport import RaftServicer
@@ -39,7 +41,6 @@ from ..utils.diskfaults import DiskFaultInjector
 from ..utils.faults import CampaignRunner, FaultInjector
 from ..utils.guards import make_serving_watchdog
 from ..utils.metrics import Metrics
-from ..utils.resilience import CircuitBreaker
 from ..utils.timeline import (
     Timeline,
     TimelineSampler,
@@ -74,7 +75,8 @@ def fault_state(faults: FaultInjector, disk_faults: DiskFaultInjector,
 
 def make_admin(lms_node: LMSNode, faults: FaultInjector,
                disk_faults: DiskFaultInjector, campaigns: CampaignRunner,
-               timeline: "Timeline | None" = None):
+               timeline: "Timeline | None" = None,
+               pool: "TutoringPool | None" = None):
     """The node's admin plane: (POST handler, GET handler) for the local
     HTTP endpoint (utils/healthz.py). Module-level (not inlined in
     serve_async) so the in-process semester-sim cluster (sim/cluster.py)
@@ -133,6 +135,38 @@ def make_admin(lms_node: LMSNode, faults: FaultInjector,
                 else:
                     faults.configure(str(body["target"]), **spec)
             return fault_state(faults, disk_faults, campaigns)
+        if path == "/admin/tutoring":
+            # Elastic fleet membership on this node's routing tier
+            # (lms/tutoring_pool.py): {"op": "add", "address": ...,
+            # "health": ...?} admits a node (warm-up weighted),
+            # {"op": "remove"} drops it, {"op": "eject"}/{"op": "join"}
+            # toggle routability without forgetting the node. Drains
+            # normally flow from the tutoring node's own POST
+            # /admin/drain via the health poller; these ops are the
+            # operator override.
+            if pool is None:
+                raise ValueError("no tutoring pool on this node")
+            op = body.get("op")
+            address = str(body.get("address", ""))
+            if not address:
+                raise ValueError("missing 'address'")
+            if op == "add":
+                pool.add_node(address,
+                              health_address=body.get("health"))
+            elif op == "remove":
+                if not pool.remove_node(address):
+                    raise ValueError(f"unknown tutoring node {address}")
+            elif op == "eject":
+                if not pool.eject(address):
+                    raise ValueError(f"unknown tutoring node {address}")
+            elif op == "join":
+                if not pool.join(address):
+                    raise ValueError(f"unknown tutoring node {address}")
+            else:
+                raise ValueError(
+                    "op must be 'add', 'remove', 'eject', or 'join'"
+                )
+            return {"ok": True, "fleet": pool.snapshot()}
         if path == "/admin/transfer":
             target = body.get("target")
             chosen = await lms_node.node.transfer_leadership(
@@ -179,6 +213,23 @@ def make_admin(lms_node: LMSNode, faults: FaultInjector,
             return trace_admin_get(path)
         if path == "/admin/timeline":
             return timeline_admin_get(path, timeline)
+        if path.startswith("/admin/tutoring"):
+            # GET /admin/tutoring — the routing tier's per-node map
+            # (state, breaker, queue depth, routes/served counts).
+            # GET /admin/tutoring/route?q=<query> — which fleet node the
+            # ring would serve this query from, and the spill order.
+            if pool is None:
+                raise KeyError(path)
+            if path == "/admin/tutoring":
+                return {"ok": True, "fleet": pool.snapshot()}
+            prefix = "/admin/tutoring/route"
+            if path.startswith(prefix):
+                qs = urllib.parse.urlparse(path).query
+                q = urllib.parse.parse_qs(qs).get("q", [""])[0]
+                if not q:
+                    raise ValueError("route needs ?q=<query>")
+                return {"ok": True, **pool.route_snapshot(q)}
+            raise KeyError(path)
         if path != "/admin/faults":
             raise KeyError(path)
         return fault_state(faults, disk_faults, campaigns)
@@ -186,7 +237,7 @@ def make_admin(lms_node: LMSNode, faults: FaultInjector,
     return admin, admin_get
 
 
-def make_health(node_id: int, lms_node: LMSNode, breaker: CircuitBreaker,
+def make_health(node_id: int, lms_node: LMSNode, pool: TutoringPool,
                 faults: FaultInjector):
     """/healthz provider closure (shared with sim/cluster.py)."""
 
@@ -201,8 +252,12 @@ def make_health(node_id: int, lms_node: LMSNode, breaker: CircuitBreaker,
                 str(k): v for k, v in lms_node.node.core.members.items()
             },
             # Resilience surface: operators see shed/degrade pressure
-            # here without scraping /metrics.
-            "tutoring_breaker": breaker.snapshot(),
+            # here without scraping /metrics. `tutoring_breaker` keeps
+            # its pre-fleet shape (the worst node's snapshot — a
+            # one-node fleet reports its only breaker, exactly as
+            # before); `tutoring_fleet` is the per-node routing map.
+            "tutoring_breaker": pool.worst_breaker_snapshot(),
+            "tutoring_fleet": pool.snapshot(),
             "faults": faults.snapshot(),
             # Storage-recovery surface: true while this node discarded
             # corrupt local state and is re-syncing from the leader.
@@ -266,18 +321,54 @@ async def serve_async(args) -> None:
             None, _read_text, args.tutoring_auth_key_file
         )).strip()
 
-    # Thresholds only; the servicer wires the log/metrics observer itself.
-    breaker = CircuitBreaker(
-        failure_threshold=args.breaker_threshold,
-        recovery_s=args.breaker_recovery,
-        half_open_max=args.breaker_half_open,
+    # The tutoring routing tier: a bare --tutoring host:port is a
+    # one-node fleet; a comma-separated list (or [tutoring_fleet]
+    # addresses) fans the forward out with cache-affinity placement,
+    # per-node breakers, spill, and hedged sends.
+    fleet_addresses = [a.strip() for a in (args.tutoring or "").split(",")
+                       if a.strip()]
+    fleet_health = [a.strip() for a in (args.tutoring_health or "").split(",")
+                    if a.strip()]
+    # Flag values get the SAME validation the TOML section enforces
+    # (list lengths, health_poll_s > 0, warmup_weight in (0, 1], ...):
+    # constructing the config dataclass runs its __post_init__, so e.g.
+    # `--tutoring-health-poll 0` fails at startup instead of busy-
+    # looping the serving loop.
+    from ..config import TutoringFleetConfig
+
+    try:
+        fleet_cfg = TutoringFleetConfig(
+            addresses=fleet_addresses,
+            health_addresses=fleet_health,
+            hedge_after_s=args.tutoring_hedge_after,
+            queue_spill_depth=args.tutoring_queue_spill,
+            warmup_s=args.tutoring_warmup,
+            warmup_weight=args.tutoring_warmup_weight,
+            health_poll_s=args.tutoring_health_poll,
+        )
+    except ValueError as e:
+        raise SystemExit(f"tutoring fleet flags: {e}") from e
+    pool = TutoringPool(
+        fleet_cfg.addresses,
+        metrics=metrics,
+        health_addresses=fleet_cfg.health_addresses,
+        fault_injector=faults,
+        breaker_failure_threshold=args.breaker_threshold,
+        breaker_recovery_s=args.breaker_recovery,
+        breaker_half_open_max=args.breaker_half_open,
+        timeout_s=args.tutoring_timeout,
+        deadline_floor_s=args.deadline_floor,
+        hedge_after_s=fleet_cfg.hedge_after_s,
+        queue_spill_depth=fleet_cfg.queue_spill_depth,
+        warmup_s=fleet_cfg.warmup_s,
+        warmup_weight=fleet_cfg.warmup_weight,
+        health_poll_s=fleet_cfg.health_poll_s,
     )
     servicer = LMSServicer(
         lms_node.node,
         lms_node.state,
         lms_node.blobs,
         gate=gate,
-        tutoring_address=args.tutoring,
         tutoring_auth_key=tutoring_auth_key,
         metrics=metrics,
         # The LMSNode's map, mutated by runtime membership changes — the
@@ -285,11 +376,11 @@ async def serve_async(args) -> None:
         peer_addresses=lms_node.addresses,
         self_id=args.id,
         linearizable_reads=args.linearizable_reads,
-        tutoring_breaker=breaker,
         fault_injector=faults,
         tutoring_timeout_s=args.tutoring_timeout,
         deadline_floor_s=args.deadline_floor,
         blob_fetch_timeout_s=args.blob_fetch_timeout,
+        tutoring_pool=pool,
     )
     server = grpc.aio.server(
         options=[
@@ -322,9 +413,13 @@ async def serve_async(args) -> None:
             metrics, interval_s=args.telemetry_interval,
             max_points=args.telemetry_ring,
         ).start()
+    # The router's health poller: drain-driven ejection/rejoin and
+    # queue-depth signals from each tutoring node's /healthz plane.
+    pool.start()
     admin, admin_get = make_admin(
         lms_node, faults, disk_faults, campaigns,
         timeline=sampler.timeline if sampler is not None else None,
+        pool=pool,
     )
 
     health = None
@@ -333,7 +428,7 @@ async def serve_async(args) -> None:
 
         health = HealthServer(
             metrics,
-            health=make_health(args.id, lms_node, breaker, faults),
+            health=make_health(args.id, lms_node, pool, faults),
             admin=admin,
             admin_get=admin_get,
             port=args.metrics_port,
@@ -362,6 +457,7 @@ async def serve_async(args) -> None:
         reporter.cancel()
         watchdog.cancel()
         campaigns.cancel()
+        await pool.close()
         if sampler is not None:
             sampler.stop()
         if health is not None:
@@ -386,7 +482,36 @@ def main(argv=None) -> None:
     parser.add_argument("--data-dir", default=None,
                         help="state directory (default ./lms_node_<id>)")
     parser.add_argument("--tutoring", default=None,
-                        help="tutoring server address (host:port)")
+                        help="tutoring fleet address(es): a single "
+                        "host:port (one-node fleet, fully "
+                        "back-compatible) or a comma-separated list "
+                        "routed with cache-affinity rendezvous hashing "
+                        "+ per-node breakers/spill/hedging "
+                        "([tutoring_fleet] addresses in the TOML)")
+    parser.add_argument("--tutoring-health", default=None,
+                        help="comma-separated /healthz endpoints "
+                        "(host:port of each tutoring node's metrics "
+                        "plane, same order as --tutoring): enables the "
+                        "router's drain-aware health poller")
+    parser.add_argument("--tutoring-hedge-after", type=float,
+                        default=0.35,
+                        help="hedge a tutoring forward to the "
+                        "second-choice node after this many seconds of "
+                        "silence (first answer wins, loser cancelled; "
+                        "0 disables hedging)")
+    parser.add_argument("--tutoring-queue-spill", type=int, default=8,
+                        help="spill to the second-choice node when the "
+                        "affinity node's serving queue is deeper than "
+                        "this (and the second's is not)")
+    parser.add_argument("--tutoring-warmup", type=float, default=5.0,
+                        help="warm-up ramp seconds for a rejoined/added "
+                        "tutoring node (its key share ramps to full as "
+                        "its prefix cache refills)")
+    parser.add_argument("--tutoring-warmup-weight", type=float,
+                        default=0.25,
+                        help="initial ring weight of a warming node")
+    parser.add_argument("--tutoring-health-poll", type=float, default=1.0,
+                        help="router health-poll cadence in seconds")
     parser.add_argument("--tutoring-auth-key-file", default=None,
                         help="file holding the LMS↔tutoring shared secret "
                         "(must match the tutoring server's --auth-key-file)")
@@ -481,9 +606,21 @@ def main(argv=None) -> None:
         # explicit-flags-win precedence.
         args.peers = [cfg.cluster.nodes[k] for k in sorted(cfg.cluster.nodes)]
         args.port = int(cfg.cluster.nodes[args.id].rsplit(":", 1)[1])
+        # [tutoring_fleet] addresses win over the single [tutoring]
+        # address when configured; both merge with explicit-flags-win
+        # precedence like everything else.
+        fleet = cfg.tutoring_fleet
         apply_file_defaults(args, parser, {
             "data_dir": os.path.join(cfg.cluster.data_dir, f"node{args.id}"),
-            "tutoring": cfg.tutoring.address,
+            "tutoring": (",".join(fleet.addresses) if fleet.addresses
+                         else cfg.tutoring.address),
+            "tutoring_health": (",".join(fleet.health_addresses)
+                                if fleet.health_addresses else None),
+            "tutoring_hedge_after": fleet.hedge_after_s,
+            "tutoring_queue_spill": fleet.queue_spill_depth,
+            "tutoring_warmup": fleet.warmup_s,
+            "tutoring_warmup_weight": fleet.warmup_weight,
+            "tutoring_health_poll": fleet.health_poll_s,
             "tutoring_auth_key_file": cfg.tutoring.auth_key_file,
             "gate_model": cfg.gate.model,
             "gate_checkpoint": cfg.gate.checkpoint,

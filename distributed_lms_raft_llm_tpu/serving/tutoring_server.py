@@ -38,7 +38,13 @@ from ..proto import lms_pb2, rpc
 from ..utils import auth
 from ..utils.guards import make_serving_watchdog
 from ..utils.metrics import Metrics
-from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
+from ..utils.resilience import (
+    Deadline,
+    DeadlineExpired,
+    Overloaded,
+    QUEUE_DEPTH_METADATA_KEY,
+    SERVED_BY_METADATA_KEY,
+)
 from ..utils.timeline import TimelineSampler, timeline_admin_get
 from ..utils.tracing import get_tracer, trace_admin_get, traced_grpc_handler
 
@@ -54,14 +60,55 @@ PROMPT_TEMPLATE = (
 
 class TutoringService(rpc.TutoringServicer):
     def __init__(self, queue: BatchingQueue, metrics: Metrics,
-                 auth_key: Optional[str] = None):
+                 auth_key: Optional[str] = None,
+                 node_id: Optional[str] = None):
         self.queue = queue
         self.metrics = metrics
         self.auth_key = auth_key
+        # Fleet identity: rides every answer's trailing metadata
+        # (x-served-by) so the router, waterfalls, and the ledger can
+        # attribute answers to fleet members.
+        self.node_id = node_id
+        self.draining = False  # guarded-by: event-loop
+
+    def set_draining(self, draining: bool) -> None:
+        """POST /admin/drain: stop admitting new queries while in-flight
+        work finishes. The fleet router observes `draining` on /healthz
+        (or the UNAVAILABLE refusal) and ejects this node from its ring;
+        un-draining re-admits it with a warm-up weight."""
+        self.draining = bool(draining)
+        self.metrics.set_gauge("tutoring_draining",
+                               1.0 if self.draining else 0.0)
+        log.info("tutoring node %s %s", self.node_id or "(unnamed)",
+                 "draining: admission stopped" if self.draining
+                 else "drain ended: admitting again")
 
     @traced_grpc_handler("tutoring.GetLLMAnswer")
     async def GetLLMAnswer(self, request, context):
         self.metrics.inc("llm_requests")
+        # Trailing metadata is buffered until the RPC completes, so it
+        # can be set up front: who served this answer + live queue depth
+        # (a passive load signal for the router between health polls).
+        # Guarded: direct servicer-level tests call with context=None.
+        if context is not None:
+            trailer = [(QUEUE_DEPTH_METADATA_KEY,
+                        str(self.queue.waiting))]
+            if self.node_id:
+                trailer.append((SERVED_BY_METADATA_KEY, self.node_id))
+            context.set_trailing_metadata(tuple(trailer))
+        if self.draining:
+            self.metrics.inc("tutoring_drain_rejections")
+            if context is not None:
+                await context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    "draining: this tutoring node is not admitting new "
+                    "work",
+                )
+            return lms_pb2.QueryResponse(
+                success=False,
+                response="draining: this tutoring node is not admitting "
+                "new work",
+            )
         if self.auth_key and not auth.verify_query(
             self.auth_key, request.query, request.token
         ):
@@ -118,6 +165,49 @@ async def _report_metrics(metrics: Metrics, period_s: float) -> None:
         log.info("metrics %s", json.dumps(metrics.snapshot()))
 
 
+def make_tutoring_admin(service: TutoringService):
+    """POST handler for the tutoring node's admin plane. Module-level
+    (like lms_server.make_admin) so the in-process semester-sim fleet
+    serves the EXACT operator surface the production entrypoint serves.
+
+    POST /admin/drain {"drain": true|false} — stop/resume admission.
+    Draining finishes in-flight work; the fleet router ejects the node
+    while it drains and re-admits it (warm-up weighted) when it ends."""
+
+    async def admin(path: str, body: dict) -> dict:
+        if path != "/admin/drain":
+            raise KeyError(path)
+        service.set_draining(bool(body.get("drain", True)))
+        return {"ok": True, "draining": service.draining,
+                "node_id": service.node_id}
+
+    return admin
+
+
+def make_tutoring_health(service: TutoringService, queue,
+                         engine_name: str, max_queue: int):
+    """/healthz provider: admission pressure + fleet lifecycle state
+    (the router's health poller reads `draining`/`queued`/`node_id`)."""
+
+    def health() -> dict:
+        return {
+            "ok": True,
+            "engine": engine_name,
+            "node_id": service.node_id,
+            # Admission pressure at a glance (details in /metrics:
+            # shed_overload / shed_expired / engine_batches). `queued`
+            # is what the bound is enforced against — for the paged
+            # queue that includes the engine's pre-slot backlog.
+            "queue_depth_limit": max_queue,
+            "queued": queue.waiting,
+            # Drain lifecycle: true while this node refuses new work and
+            # finishes what it holds; the router ejects it meanwhile.
+            "draining": service.draining,
+        }
+
+    return health
+
+
 async def serve_async(
     port: int,
     engine,
@@ -132,6 +222,7 @@ async def serve_async(
     telemetry: bool = True,
     telemetry_interval_s: float = 1.0,
     telemetry_ring: int = 600,
+    node_id: Optional[str] = None,
 ) -> grpc.aio.Server:
     """Start (and return) the aio server; caller awaits termination.
 
@@ -155,9 +246,9 @@ async def serve_async(
             ("grpc.max_receive_message_length", 50 * 1024 * 1024),
         ]
     )
-    rpc.add_TutoringServicer_to_server(
-        TutoringService(queue, metrics, auth_key=auth_key), server
-    )
+    service = TutoringService(queue, metrics, auth_key=auth_key,
+                              node_id=node_id)
+    rpc.add_TutoringServicer_to_server(service, server)
     server._port = server.add_insecure_port(f"[::]:{port}")
     await server.start()
     # Keep strong references (asyncio tasks are weakly held by the loop) and
@@ -214,16 +305,9 @@ async def serve_async(
 
         server._health = HealthServer(
             metrics,
-            health=lambda: {
-                "ok": True,
-                "engine": type(engine).__name__,
-                # Admission pressure at a glance (details in /metrics:
-                # shed_overload / shed_expired / engine_batches). `waiting`
-                # is what the bound is enforced against — for the paged
-                # queue that includes the engine's pre-slot backlog.
-                "queue_depth_limit": max_queue,
-                "queued": queue.waiting,
-            },
+            health=make_tutoring_health(service, queue,
+                                        type(engine).__name__, max_queue),
+            admin=make_tutoring_admin(service),
             admin_get=admin_get,
             port=metrics_port,
         )
@@ -336,9 +420,18 @@ def main(argv=None) -> None:
                         "n-gram continuation; ngram = per-slot "
                         "modal-continuation table (paged only, higher "
                         "acceptance at temperature>0)")
+    parser.add_argument("--node-id", default=None,
+                        help="fleet member identity: rides every "
+                        "answer's x-served-by response trailer and "
+                        "/healthz so the LMS routing tier, waterfalls, "
+                        "and the ledger can attribute answers (default: "
+                        "tut-<port>)")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="HTTP /healthz + /metrics endpoint (0 = "
-                             "ephemeral); omit to disable")
+                             "ephemeral); omit to disable. Also serves "
+                             "POST /admin/drain (stop admission, finish "
+                             "in-flight work; the fleet router ejects "
+                             "this node until the drain ends)")
     parser.add_argument("--no-telemetry", action="store_true",
                         help="disable the node-local telemetry timeline "
                              "(sampler thread + GET /admin/timeline)")
@@ -491,6 +584,7 @@ def main(argv=None) -> None:
             telemetry=args.telemetry,
             telemetry_interval_s=args.telemetry_interval,
             telemetry_ring=args.telemetry_ring,
+            node_id=args.node_id or f"tut-{args.port}",
         )
         await server.wait_for_termination()
 

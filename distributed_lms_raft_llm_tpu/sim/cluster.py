@@ -36,17 +36,21 @@ import grpc
 from ..config import SimConfig
 from ..lms.node import LMSNode
 from ..lms.service import FileTransferServicer, LMSServicer
+from ..lms.tutoring_pool import TutoringPool
 from ..proto import rpc
 from ..raft import RaftConfig
 from ..raft.grpc_transport import RaftServicer
 from ..serving.lms_server import make_admin, make_health
-from ..serving.tutoring_server import TutoringService
+from ..serving.tutoring_server import (
+    TutoringService,
+    make_tutoring_admin,
+    make_tutoring_health,
+)
 from ..utils.diskfaults import DiskFaultInjector
 from ..utils.faults import CampaignRunner, FaultInjector
 from ..utils.guards import make_serving_watchdog
 from ..utils.healthz import HealthServer
 from ..utils.metrics import Metrics
-from ..utils.resilience import CircuitBreaker
 from ..utils.timeline import TimelineSampler
 
 log = logging.getLogger(__name__)
@@ -153,8 +157,11 @@ class SimCluster:
         self._addresses: Dict[int, str] = {}    # guarded-by: _lock
         self._extra: Optional[int] = None       # guarded-by: _lock
         self._lock = threading.Lock()
-        self._tutoring: Dict = {}
-        self._tutoring_addr: Optional[str] = None
+        # Tutoring fleet: index -> node record; addresses pinned for the
+        # cluster's lifetime like the LMS ports.
+        self._tutoring: Dict[int, Dict] = {}     # guarded-by: _lock
+        self._tutoring_addrs: Dict[int, str] = {}        # guarded-by: _lock
+        self._tutoring_health: Dict[int, str] = {}       # guarded-by: _lock
 
     # ------------------------------------------------------------ lifecycle
 
@@ -168,7 +175,8 @@ class SimCluster:
             target=self._loop_main, name="sim-cluster", daemon=True
         )
         self._thread.start()
-        self._run(self._boot_tutoring(), timeout=120.0)
+        for idx in range(getattr(self.cfg, "tutoring_nodes", 1)):
+            self._run(self._boot_tutoring_node(idx), timeout=120.0)
         for nid in range(1, self.n_base + 1):
             self._run(self._boot_node(nid), timeout=60.0)
         if self.wait_leader(timeout=20.0) is None:
@@ -180,10 +188,11 @@ class SimCluster:
                 self._run(self._stop_node(nid), timeout=30.0)
             except Exception:
                 log.exception("stopping sim node %d failed", nid)
-        try:
-            self._run(self._stop_tutoring(), timeout=30.0)
-        except Exception:
-            log.exception("stopping sim tutoring failed")
+        for idx in list(self._tutoring):
+            try:
+                self._run(self._stop_tutoring_node(idx), timeout=30.0)
+            except Exception:
+                log.exception("stopping sim tutoring node %d failed", idx)
         self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
             self._thread.join(timeout=10.0)
@@ -276,13 +285,112 @@ class SimCluster:
         )
         return self._http(req)
 
+    def tutoring_count(self) -> int:
+        with self._lock:
+            return len(self._tutoring)
+
+    def tutoring_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._tutoring)
+
+    def tutoring_addresses(self) -> List[str]:
+        with self._lock:
+            return [self._tutoring_addrs[i]
+                    for i in sorted(self._tutoring_addrs)
+                    if i in self._tutoring]
+
+    def tutoring_health_addresses(self) -> List[str]:
+        with self._lock:
+            return [self._tutoring_health[i]
+                    for i in sorted(self._tutoring_health)
+                    if i in self._tutoring]
+
+    def tutoring_health_port(self, idx: int) -> int:
+        with self._lock:
+            return int(self._tutoring_health[idx].rsplit(":", 1)[1])
+
+    def tutoring_admin_post(self, idx: int, path: str, body: Dict) -> Dict:
+        """POST to one tutoring node's admin plane (e.g. /admin/drain)."""
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.tutoring_health_port(idx)}{path}",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            return self._http(req, timeout=30.0)
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise RuntimeError(
+                f"tutoring admin POST {path} on node {idx} -> "
+                f"{e.code}: {detail}"
+            ) from e
+
+    def tutoring_healthz(self, idx: int) -> Dict:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.tutoring_health_port(idx)}/healthz",
+            method="GET",
+        )
+        return self._http(req)
+
+    def spawn_tutoring_node(self) -> tuple:
+        """Boot one more (echo) tutoring node for the autoscale drill;
+        returns (idx, address, health_address). The LMS routers learn it
+        via POST /admin/tutoring."""
+        with self._lock:
+            idx = (max(self._tutoring_addrs) + 1 if self._tutoring_addrs
+                   else 0)
+        self._run(self._boot_tutoring_node(idx, force_echo=True),
+                  timeout=60.0)
+        with self._lock:
+            return idx, self._tutoring_addrs[idx], self._tutoring_health[idx]
+
+    def stop_tutoring_node(self, idx: int) -> None:
+        self._run(self._stop_tutoring_node(idx), timeout=30.0)
+
+    def tutoring_node_metrics(self, idx: int) -> Dict:
+        with self._lock:
+            rec = self._tutoring.get(idx)
+        return rec["metrics"].snapshot() if rec else {}
+
     def tutoring_metrics_snapshot(self) -> Dict:
-        """The tutoring node's serving-queue Metrics snapshot (hit
-        rates, shed counters). Snapshot() is thread-safe; {} before
-        boot/after teardown."""
-        if not self._tutoring:
+        """The tutoring FLEET's serving Metrics, merged (counters
+        summed, gauges maxed, histograms by worst p95) — the shape the
+        SLO verdict and the telemetry "tutoring" source read. {} before
+        boot/after teardown. Snapshot() is thread-safe."""
+        with self._lock:
+            recs = list(self._tutoring.values())
+        snaps = [rec["metrics"].snapshot() for rec in recs]
+        if not snaps:
             return {}
-        return self._tutoring["metrics"].snapshot()
+        if len(snaps) == 1:
+            return snaps[0]
+        merged: Dict = {"counters": {}, "gauges": {}, "latency": {}}
+        for snap in snaps:
+            for name, val in snap.get("counters", {}).items():
+                merged["counters"][name] = (
+                    merged["counters"].get(name, 0) + int(val)
+                )
+            for name, val in snap.get("gauges", {}).items():
+                merged["gauges"][name] = max(
+                    merged["gauges"].get(name, float("-inf")), float(val)
+                )
+            for name, block in snap.get("latency", {}).items():
+                worst = merged["latency"].get(name)
+                if worst is None or float(block.get("p95_s", 0.0)) > float(
+                    worst.get("p95_s", 0.0)
+                ):
+                    merged["latency"][name] = dict(block)
+        # Percentiles come from the worst node, but `count` must be the
+        # fleet SUM: a per-node count would jump whenever the worst node
+        # flips, and Timeline.append would misread the jumps as counter
+        # resets — phantom observations in hist_rate/dcount (the same
+        # rule utils/scrape.py applies to its cluster merge).
+        for name, block in merged["latency"].items():
+            block["count"] = float(sum(
+                float(s.get("latency", {}).get(name, {}).get("count", 0))
+                for s in snaps
+            ))
+        return merged
 
     def scrape_all(self) -> tuple:
         """({nid: /metrics}, {nid: /healthz}) for every live node."""
@@ -347,12 +455,19 @@ class SimCluster:
 
     # ------------------------------------------------------------ coroutines
 
-    async def _boot_tutoring(self) -> None:
+    async def _boot_tutoring_node(self, idx: int,
+                                  force_echo: bool = False) -> None:
+        """One tutoring fleet member: real gRPC server + the SAME
+        healthz/drain admin plane the production entrypoint serves
+        (make_tutoring_health/make_tutoring_admin). Node 0 runs the
+        configured engine; extra members (and autoscale spawns) run the
+        echo stand-in so a 3-node fleet costs no extra XLA compiles."""
         from ..engine import BatchingQueue, PagedQueue
 
         queue = None
         metrics = Metrics()
-        if self.cfg.tutoring_engine in ("tiny", "tiny-paged"):
+        if (self.cfg.tutoring_engine in ("tiny", "tiny-paged")
+                and idx == 0 and not force_echo):
             import jax
 
             from ..engine import (
@@ -412,21 +527,40 @@ class SimCluster:
                                   metrics=metrics, max_queue=64)
         await queue.start()
         server = grpc.aio.server()
-        rpc.add_TutoringServicer_to_server(
-            TutoringService(queue, metrics), server
-        )
-        port = server.add_insecure_port("127.0.0.1:0")
+        service = TutoringService(queue, metrics, node_id=f"tut{idx}")
+        rpc.add_TutoringServicer_to_server(service, server)
+        with self._lock:
+            want = self._tutoring_addrs.get(idx)
+        if want is not None:
+            port = server.add_insecure_port(want)
+        else:
+            port = server.add_insecure_port("127.0.0.1:0")
         await server.start()
-        self._tutoring = {"server": server, "queue": queue,
-                          "metrics": metrics}
-        self._tutoring_addr = f"127.0.0.1:{port}"
+        health = HealthServer(
+            metrics,
+            health=make_tutoring_health(service, queue,
+                                        type(engine).__name__, 64),
+            admin=make_tutoring_admin(service),
+            port=(self.tutoring_health_port(idx) if want is not None
+                  else 0),
+        )
+        hport = await health.start()
+        with self._lock:
+            self._tutoring[idx] = {
+                "server": server, "queue": queue, "metrics": metrics,
+                "service": service, "health": health,
+            }
+            self._tutoring_addrs[idx] = f"127.0.0.1:{port}"
+            self._tutoring_health[idx] = f"127.0.0.1:{hport}"
 
-    async def _stop_tutoring(self) -> None:
-        if not self._tutoring:
+    async def _stop_tutoring_node(self, idx: int) -> None:
+        with self._lock:
+            rec = self._tutoring.pop(idx, None)
+        if rec is None:
             return
-        await self._tutoring["server"].stop(None)
-        await self._tutoring["queue"].close()
-        self._tutoring = {}
+        await rec["health"].stop()
+        await rec["server"].stop(None)
+        await rec["queue"].close()
 
     async def _boot_node(self, nid: int) -> None:
         cfg = self.cfg
@@ -436,24 +570,40 @@ class SimCluster:
         faults = FaultInjector(seed=cfg.seed * 1000 + nid)
         disk_faults = DiskFaultInjector(seed=cfg.seed * 1000 + nid)
         metrics = Metrics()
-        breaker = CircuitBreaker(failure_threshold=3, recovery_s=0.5)
         lms_node = LMSNode(
             nid, addresses, f"{self.workdir}/node{nid}",
             raft_config=SIM_RAFT, snapshot_every=SIM_SNAPSHOT_EVERY,
             fault_injector=faults, disk_fault_injector=disk_faults,
             metrics=metrics,
         )
+        # The tutoring routing tier, fleet-sized to [sim] tutoring_nodes:
+        # sim-scale spill/hedge/warm-up knobs so the drills resolve
+        # inside a seconds-long run (hedge after 100 ms, 1 s warm-up,
+        # 200 ms health polls driving drain ejection/rejoin).
+        pool = TutoringPool(
+            self.tutoring_addresses(),
+            metrics=metrics,
+            health_addresses=self.tutoring_health_addresses(),
+            fault_injector=faults,
+            breaker_failure_threshold=3,
+            breaker_recovery_s=0.5,
+            timeout_s=min(30.0, cfg.llm_budget_s),
+            deadline_floor_s=0.25,
+            hedge_after_s=0.1,
+            queue_spill_depth=16,
+            warmup_s=1.0,
+            health_poll_s=0.2,
+        )
         servicer = LMSServicer(
             lms_node.node, lms_node.state, lms_node.blobs,
             gate=KeywordGate(),
-            tutoring_address=self._tutoring_addr,
             metrics=metrics,
             peer_addresses=lms_node.addresses,
             self_id=nid,
-            tutoring_breaker=breaker,
             fault_injector=faults,
             tutoring_timeout_s=min(30.0, cfg.llm_budget_s),
             deadline_floor_s=0.25,
+            tutoring_pool=pool,
         )
         server = grpc.aio.server(
             options=[("grpc.max_receive_message_length", 50 * 1024 * 1024)]
@@ -479,12 +629,16 @@ class SimCluster:
         # samples, served at GET /admin/timeline per node.
         sampler = TimelineSampler(metrics, interval_s=0.5,
                                   max_points=256).start()
+        # The router's drain-aware health poller, like the production
+        # entrypoint starts.
+        pool.start()
         admin, admin_get = make_admin(lms_node, faults, disk_faults,
                                       campaigns,
-                                      timeline=sampler.timeline)
+                                      timeline=sampler.timeline,
+                                      pool=pool)
         health = HealthServer(
             metrics,
-            health=make_health(nid, lms_node, breaker, faults),
+            health=make_health(nid, lms_node, pool, faults),
             admin=admin, admin_get=admin_get,
             port=self._health_ports[nid],
         )
@@ -499,7 +653,7 @@ class SimCluster:
                 "lms_node": lms_node, "server": server, "health": health,
                 "faults": faults, "disk_faults": disk_faults,
                 "campaigns": campaigns, "metrics": metrics,
-                "breaker": breaker, "watchdog": watchdog,
+                "pool": pool, "watchdog": watchdog,
                 "sampler": sampler,
             }
 
@@ -511,6 +665,7 @@ class SimCluster:
         rec["campaigns"].cancel()
         rec["watchdog"].cancel()
         rec["sampler"].stop()
+        await rec["pool"].close()
         await rec["health"].stop()
         await rec["lms_node"].stop()
         await rec["server"].stop(None)

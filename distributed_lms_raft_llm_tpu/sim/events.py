@@ -24,6 +24,7 @@ import logging
 import random
 import threading
 import time
+import urllib.parse
 from typing import Dict, List, Optional
 
 from ..config import SimConfig
@@ -36,6 +37,19 @@ ROLLING_RESTART = "rolling_restart"
 QUARANTINE = "quarantine"
 MEMBERSHIP_ADD = "membership_add"
 MEMBERSHIP_REMOVE = "membership_remove"
+# Tutoring-fleet drills ([sim] tutoring_nodes > 1): brownout-then-
+# blackout of ONE fleet member (hedge wins, then router spill), a
+# drain-and-rejoin cycle (ejection, warm-up re-admission, affinity
+# restored), and an autoscale add/drain/remove under load.
+TUTORING_BLACKOUT = "tutoring_blackout"
+TUTORING_DRAIN = "tutoring_drain_rejoin"
+TUTORING_AUTOSCALE = "tutoring_autoscale"
+
+# The ops bot's fixed ask: the fleet drills resolve ITS affinity node
+# via GET /admin/tutoring/route and then fault/drain exactly that node,
+# so a probe's hedge/spill is guaranteed to exercise the router (the
+# harness's asker issues this same query).
+PROBE_QUERY = "ops bot probe: what is Raft?"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,7 +87,7 @@ def plan_events(cfg: SimConfig) -> List[SimEvent]:
     # sustain requirement — a blackout shorter than the window can only
     # ever produce diluted ratios.
     outage_hold = max(1.5, 0.08 * T)
-    return [
+    events = [
         SimEvent(
             at_s=_jitter(rng, 0.12, 0.02) * T, kind=CHAOS_CAMPAIGN,
             params={
@@ -94,6 +108,26 @@ def plan_events(cfg: SimConfig) -> List[SimEvent]:
         SimEvent(at_s=_jitter(rng, 0.90, 0.02) * T, kind=MEMBERSHIP_REMOVE,
                  params={}),
     ]
+    if cfg.tutoring_nodes > 1:
+        # Fleet drills land AFTER the rolling restart (0.38T): the node
+        # that routes (and counts hedges/spills) must not be restarted
+        # out from under the drill's counter deltas.
+        events += [
+            SimEvent(
+                at_s=_jitter(rng, 0.48, 0.02) * T, kind=TUTORING_BLACKOUT,
+                params={
+                    "brownout_s": round(max(2.0, 0.10 * T), 3),
+                    "outage_s": round(max(1.5, 0.08 * T), 3),
+                    "delay_s": 0.6,
+                },
+            ),
+            SimEvent(at_s=_jitter(rng, 0.64, 0.02) * T,
+                     kind=TUTORING_DRAIN, params={}),
+            SimEvent(at_s=_jitter(rng, 0.84, 0.02) * T,
+                     kind=TUTORING_AUTOSCALE,
+                     params={"hold_s": round(max(0.8, 0.04 * T), 3)}),
+        ]
+    return events
 
 
 class OperationsScheduler:
@@ -170,6 +204,9 @@ class OperationsScheduler:
                     QUARANTINE: self._quarantine,
                     MEMBERSHIP_ADD: self._membership_add,
                     MEMBERSHIP_REMOVE: self._membership_remove,
+                    TUTORING_BLACKOUT: self._tutoring_blackout,
+                    TUTORING_DRAIN: self._tutoring_drain,
+                    TUTORING_AUTOSCALE: self._tutoring_autoscale,
                 }[event.kind]
                 outcome["detail"] = handler(event)
                 outcome["ok"] = True
@@ -376,3 +413,183 @@ class OperationsScheduler:
         )
         self.cluster.stop_node(nid)
         return f"removed node {nid} and stopped it"
+
+    # ------------------------------------------------------ fleet drills
+
+    def _probe_route(self, nid: int) -> Dict:
+        """Where the ring on LMS node `nid` would send the ops bot's
+        probe query (GET /admin/tutoring/route)."""
+        doc = self.cluster.admin_get(
+            nid,
+            "/admin/tutoring/route?q=" + urllib.parse.quote(PROBE_QUERY),
+        )
+        if not doc.get("order"):
+            raise RuntimeError(f"empty tutoring route on node {nid}: "
+                               f"{doc}")
+        return doc
+
+    def _fleet_counter(self, name: str) -> int:
+        """Summed across every live LMS node: whichever node leads (and
+        therefore routes) during the drill contributes its counters."""
+        total = 0
+        for nid in self.cluster.node_ids():
+            try:
+                snap = self.cluster.metrics_snapshot(nid)
+            except Exception:
+                continue
+            total += int(snap.get("counters", {}).get(name, 0))
+        return total
+
+    def _probe_until(self, counter: str, baseline: int, end: float,
+                     settle_s: float = 0.05) -> int:
+        """Drive ops-bot asks until `counter` moves past `baseline` or
+        the window closes; returns the final reading."""
+        value = baseline
+        while time.monotonic() < end - 0.1:
+            if self.asker is not None:
+                self.asker()
+            value = self._fleet_counter(counter)
+            if value > baseline:
+                break
+            time.sleep(settle_s)
+        return value
+
+    def _tutoring_blackout(self, event: SimEvent) -> str:
+        """Kill-one-of-N: brownout (injected delay) then full blackout
+        of exactly the probe query's affinity node, via the per-node
+        fault target `tutoring:<i>`. The brownout must produce a hedge
+        win (the second choice answers while the affinity node sits on
+        the request); the blackout must produce a router spill within
+        its own window — tail-tolerance proven from /metrics, not
+        assumed."""
+        p = event.params
+        leader = self._leader()
+        route = self._probe_route(leader)
+        idx = route["order"][0]["index"]
+        self.cluster.admin_post(leader, "/admin/faults", {"campaign": {
+            "name": "sim-fleet-brownout-blackout",
+            "phases": [
+                {"target": f"tutoring:{idx}",
+                 "duration_s": p["brownout_s"], "delay_s": p["delay_s"]},
+                {"target": f"tutoring:{idx}",
+                 "duration_s": p["outage_s"], "drop": 1.0},
+            ],
+        }})
+        t0 = time.monotonic()
+        wins0 = self._fleet_counter(metric.TUTORING_HEDGE_WINS)
+        wins = self._probe_until(metric.TUTORING_HEDGE_WINS, wins0,
+                                 t0 + p["brownout_s"])
+        time.sleep(max(0.0, t0 + p["brownout_s"] - time.monotonic()))
+        # Baseline AFTER the brownout: hedge wins are served
+        # off-affinity and count as spills too, so a pre-brownout
+        # baseline would make the blackout-phase assertion vacuous.
+        spills0 = self._fleet_counter(metric.TUTORING_SPILLS)
+        spills = self._probe_until(
+            metric.TUTORING_SPILLS, spills0,
+            t0 + p["brownout_s"] + p["outage_s"],
+        )
+        time.sleep(max(0.0, t0 + p["brownout_s"] + p["outage_s"]
+                       - time.monotonic()))
+        if wins <= wins0:
+            raise RuntimeError(
+                f"no hedge win during the {p['brownout_s']}s brownout "
+                f"of tutoring:{idx}"
+            )
+        if spills <= spills0:
+            raise RuntimeError(
+                f"no router spill during the {p['outage_s']}s blackout "
+                f"of tutoring:{idx}"
+            )
+        return (f"browned out tutoring:{idx} {p['brownout_s']}s "
+                f"(hedge wins +{wins - wins0}), blacked it out "
+                f"{p['outage_s']}s (spills +{spills - spills0}); the "
+                "router spilled within the outage window")
+
+    def _tutoring_drain(self, event: SimEvent) -> str:
+        """Elastic drain-and-rejoin: POST /admin/drain on the probe's
+        affinity node, watch the router eject it (health poller), keep
+        serving via the second choice, end the drain, and verify the
+        ring routes the probe key BACK to the node once its warm-up
+        ramp finishes — cache affinity restored, not just liveness."""
+        leader = self._leader()
+        route = self._probe_route(leader)
+        idx = route["order"][0]["index"]
+        address = route["order"][0]["address"]
+        self.cluster.tutoring_admin_post(idx, "/admin/drain",
+                                         {"drain": True})
+        self._wait(lambda: self.cluster.tutoring_healthz(idx)
+                   .get("draining") and
+                   self.cluster.tutoring_healthz(idx).get("queued") == 0,
+                   10.0, f"tutoring node {idx} drained")
+        self._wait(lambda: self._fleet_state(leader, address)
+                   in ("draining", "ejected"),
+                   10.0, f"router ejected {address}")
+        if self.asker is not None:
+            self.asker()  # served by the second choice while drained
+        mid = self._probe_route(self._leader())
+        if mid["order"] and mid["order"][0]["index"] == idx:
+            raise RuntimeError(
+                f"probe still routed to draining node {idx}: {mid}"
+            )
+        self.cluster.tutoring_admin_post(idx, "/admin/drain",
+                                         {"drain": False})
+        self._wait(lambda: self._fleet_state(leader, address)
+                   in ("warming", "ok"),
+                   10.0, f"router re-admitted {address}")
+        self._wait(lambda: self._fleet_state(leader, address) == "ok",
+                   10.0, f"warm-up of {address} finished")
+        back = self._probe_route(leader)
+        if back["order"][0]["index"] != idx:
+            raise RuntimeError(
+                f"affinity not restored after rejoin: probe routes to "
+                f"{back['order'][0]} instead of node {idx}"
+            )
+        return (f"drained tutoring:{idx} (router ejected it, traffic "
+                "spilled), rejoined with warm-up; probe affinity "
+                "restored to the same node")
+
+    def _tutoring_autoscale(self, event: SimEvent) -> str:
+        """Autoscaling drill: add a fleet member under load (every LMS
+        router admits it, warm-up weighted), hold, then drain + remove
+        it — the add/remove remaps only the new node's ~1/N key share
+        (rendezvous), so the survivors' prefix caches stay warm."""
+        p = event.params
+        idx, address, health = self.cluster.spawn_tutoring_node()
+        for nid in self.cluster.node_ids():
+            self.cluster.admin_post(nid, "/admin/tutoring",
+                                    {"op": "add", "address": address,
+                                     "health": health})
+        leader = self._leader()
+        self._wait(lambda: self._fleet_state(leader, address)
+                   in ("warming", "ok"),
+                   10.0, f"router admitted {address}")
+        time.sleep(p["hold_s"])  # serve under load as a fleet of N+1
+        self.cluster.tutoring_admin_post(idx, "/admin/drain",
+                                         {"drain": True})
+        self._wait(lambda: self.cluster.tutoring_healthz(idx)
+                   .get("queued") == 0,
+                   10.0, f"autoscaled node {idx} drained")
+        for nid in self.cluster.node_ids():
+            self.cluster.admin_post(nid, "/admin/tutoring",
+                                    {"op": "remove", "address": address})
+        self.cluster.stop_tutoring_node(idx)
+        return (f"scaled the fleet up with {address} under load, then "
+                "drained and removed it")
+
+    def _fleet_state(self, nid: int, address: str) -> Optional[str]:
+        health = self.cluster.healthz(nid)
+        for node in health.get("tutoring_fleet", {}).get("nodes", ()):
+            if node["address"] == address:
+                return node["state"]
+        return None
+
+    def _wait(self, pred, timeout: float, what: str) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if pred():
+                    return
+            except Exception:
+                pass
+            time.sleep(0.05)
+        raise RuntimeError(f"timed out waiting for {what}")

@@ -91,6 +91,13 @@ class _TelemetryLoop:
                 )
                 for nid in cluster.node_ids()
             }
+            # "tutoring" is the merged fleet view (counters summed
+            # across members — the capacity fit and degraded-rate burn
+            # read it). Per-node fleet attribution lives in the BENCH
+            # record's tutoring_fleet block, NOT as extra scrape
+            # sources: feeding both the merged view and per-node views
+            # into one ClusterScraper would double-count every tutoring
+            # counter in the cluster timeline.
             out["tutoring"] = cluster.tutoring_metrics_snapshot
             out["sim"] = sim.metrics.snapshot
             return out
@@ -197,6 +204,7 @@ class SemesterSim:
             self._audit()
             node_metrics, node_health = self.cluster.scrape_all()
             traces = get_tracer().records()
+            fleet = self._fleet_summary(node_metrics, node_health)
             report = evaluate_slos(
                 self.cfg, node_metrics, node_health,
                 self.metrics.snapshot(), self.ledger.report(),
@@ -206,10 +214,11 @@ class SemesterSim:
                 metrics=self.metrics,
                 continuous=(telemetry.engine.report()
                             if telemetry is not None else None),
+                fleet=fleet,
             )
             return self._record(ops, plan, scheduler, report, node_metrics,
                                 traces, time.monotonic() - t_start,
-                                telemetry=telemetry)
+                                telemetry=telemetry, fleet=fleet)
         finally:
             for c in self._clients.values():
                 c.close()
@@ -349,17 +358,17 @@ class SemesterSim:
         return False
 
     def _bot_ask(self) -> bool:
-        """One ask_llm probe; True if it was answered degraded."""
+        """One ask_llm probe (the fleet drills resolve THIS query's
+        affinity node and fault it, so the probe's hedge/spill is
+        guaranteed to exercise the router); True if answered degraded."""
         try:
-            resp = self._ops_bot.ask_llm("ops bot probe: what is Raft?",
-                                         budget_s=4.0)
+            resp = self._ops_bot.ask_llm(ev.PROBE_QUERY, budget_s=4.0)
         except _CLIENT_ERRORS as e:
             log.info("ops bot ask failed: %s", e)
             return False
         if _is_degraded(resp):
             self.metrics.inc(metric.SIM_DEGRADED_ANSWERS)
-            self.ledger.record(QUERY, ("ops_bot",),
-                               "ops bot probe: what is Raft?")
+            self.ledger.record(QUERY, ("ops_bot",), ev.PROBE_QUERY)
             return True
         return False
 
@@ -565,8 +574,39 @@ class SemesterSim:
 
     # ---------------------------------------------------------------- record
 
+    def _fleet_summary(self, node_metrics: Dict, node_health: Dict):
+        """Tutoring-fleet verdict inputs: router counters summed across
+        the LMS nodes (whichever node led during a drill holds them)
+        plus the end-state per-node routing map. None for a one-node
+        fleet — the checks and record fields only exist when there is a
+        fleet to judge."""
+        if self.cluster.tutoring_count() <= 1:
+            return None
+
+        def total(name: str) -> int:
+            return sum(snap_counter(s, name)
+                       for s in node_metrics.values())
+
+        nodes = []
+        for health in node_health.values():
+            fleet = health.get("tutoring_fleet") or {}
+            if fleet.get("nodes"):
+                nodes = fleet["nodes"]
+                break
+        return {
+            "size": self.cluster.tutoring_count(),
+            "drills": self.cfg.events,
+            "spills": total(metric.TUTORING_SPILLS),
+            "hedges": total(metric.TUTORING_HEDGES),
+            "hedge_wins": total(metric.TUTORING_HEDGE_WINS),
+            "ejections": total(metric.TUTORING_NODE_EJECTIONS),
+            "rejoins": total(metric.TUTORING_NODE_REJOINS),
+            "nodes": nodes,
+        }
+
     def _record(self, ops, plan, scheduler, report, node_metrics,
-                traces, wall_s: float, telemetry=None) -> Dict:
+                traces, wall_s: float, telemetry=None,
+                fleet=None) -> Dict:
         snap = self.metrics.snapshot()
         counters = snap.get("counters", {})
         ask = snap_hist(snap, metric.SIM_ASK_LATENCY)
@@ -604,6 +644,12 @@ class SemesterSim:
             "students": self.cfg.students,
             "duration_s": self.cfg.duration_s,
             "tutoring_engine": self.cfg.tutoring_engine,
+            "tutoring_nodes": self.cfg.tutoring_nodes,
+            # Fleet router outcome (None for a one-node fleet): spill /
+            # hedge / ejection counts plus the end-state routing map —
+            # the acceptance evidence for the kill-one-of-N and
+            # drain-and-rejoin drills.
+            "tutoring_fleet": fleet,
             "course_concentration": self.cfg.course_concentration,
             # Measured shared-prefix KV cache hit rate on the tutoring
             # node (None unless the engine runs the radix cache, i.e.

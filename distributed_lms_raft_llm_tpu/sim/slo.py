@@ -79,6 +79,9 @@ class SloReport:
     # fault classification); None when the run evaluated SLOs only at
     # the end ([sim] continuous_slos = false).
     continuous: Optional[Dict[str, Any]] = None
+    # Tutoring-fleet summary (router spill/hedge counters + per-node
+    # end-state map); None for a one-node fleet.
+    fleet: Optional[Dict[str, Any]] = None
 
     @property
     def ok(self) -> bool:
@@ -96,6 +99,7 @@ class SloReport:
             "stage_p95s": self.stage_p95s,
             "prefix_cache_hit_rate": self.prefix_cache_hit_rate,
             "continuous": self.continuous,
+            "fleet": self.fleet,
         }
 
 
@@ -347,6 +351,7 @@ def evaluate_slos(
     tutoring_metrics: Optional[Dict[str, Any]] = None,
     metrics: Optional[Metrics] = None,
     continuous: Optional[Dict[str, Any]] = None,
+    fleet: Optional[Dict[str, Any]] = None,
 ) -> SloReport:
     """`node_metrics`/`node_health`: node id -> scraped JSON snapshots of
     every node alive at the end of the run; `sim_metrics`: the harness's
@@ -451,10 +456,32 @@ def evaluate_slos(
             "every alert inside an injected-fault phase",
         )
 
+    if fleet is not None:
+        # Fleet verdicts (only when there IS a fleet, [sim]
+        # tutoring_nodes > 1): the drills must leave measured evidence
+        # — >=1 router spill and >=1 hedge win — and no node may end the
+        # run stuck out of the ring (ejected/draining after settle means
+        # a drain that never rejoined).
+        if fleet.get("drills"):
+            check("fleet_spill_observed", fleet.get("spills", 0) >= 1,
+                  f"{fleet.get('spills', 0)} spills", ">= 1 router spill")
+            check("fleet_hedge_win_observed",
+                  fleet.get("hedge_wins", 0) >= 1,
+                  f"{fleet.get('hedge_wins', 0)} hedge wins "
+                  f"({fleet.get('hedges', 0)} hedged)",
+                  ">= 1 hedged answer won")
+        stuck_nodes = [n["address"] for n in fleet.get("nodes", ())
+                       if n.get("state") in ("draining", "ejected")]
+        check("fleet_nodes_routable", not stuck_nodes,
+              f"out of ring: {stuck_nodes}" if stuck_nodes
+              else f"all {fleet.get('size', 0)} nodes routable",
+              "no node left ejected/draining")
+
     hit_rate = snap_gauge(tutoring_metrics or {},
                           metric.PREFIX_CACHE_HIT_RATE, default=-1.0)
     return SloReport(
         checks=checks, stage_p95s=stage_breakdown(traces),
         prefix_cache_hit_rate=hit_rate if hit_rate >= 0 else None,
         continuous=continuous,
+        fleet=fleet,
     )

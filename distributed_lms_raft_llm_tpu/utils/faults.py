@@ -109,8 +109,16 @@ class FaultInjector:
                 self._specs.pop(target, None)
 
     def spec_for(self, target: str) -> Optional[FaultSpec]:
+        """Most-specific spec for `target`, with hierarchical fallback:
+        `tutoring:2` falls back to `tutoring`, then to the `*` wildcard —
+        so per-fleet-member chaos (`tutoring:<i>`) composes with the
+        legacy whole-tier target and one spec can still blanket a node's
+        entire egress."""
         with self._lock:
-            return self._specs.get(target) or self._specs.get("*")
+            spec = self._specs.get(target)
+            if spec is None and ":" in target:
+                spec = self._specs.get(target.rsplit(":", 1)[0])
+            return spec or self._specs.get("*")
 
     def plan(self, target: str) -> FaultPlan:
         """Sample this send's faults (single RNG; lock keeps the stream

@@ -168,6 +168,41 @@ REPLICATE_BUDGET_EXHAUSTED = counter(
     "budget ran out mid-sweep (anti-entropy heals them later)",
 )
 
+# Tutoring fleet router (lms/tutoring_pool.py) — cache-affinity routing,
+# spill, hedging, and elastic membership across N tutoring nodes.
+
+TUTORING_SPILLS = counter(
+    "tutoring_spills",
+    "tutoring forwards served by a non-affinity fleet node (the router "
+    "spilled past the ring's first choice: open breaker, deep queue, "
+    "insufficient budget, or the affinity node failed/was ejected)",
+)
+TUTORING_HEDGES = counter(
+    "tutoring_hedges",
+    "hedged duplicate sends issued after the affinity node sat on a "
+    "forward past hedge_after_s (tail-tolerance; the loser is cancelled)",
+)
+TUTORING_HEDGE_WINS = counter(
+    "tutoring_hedge_wins",
+    "tutoring answers won by the hedged (second-choice) send — the tail "
+    "latency the hedge actually shaved",
+)
+TUTORING_NODE_EJECTIONS = counter(
+    "tutoring_node_ejections",
+    "fleet members the router ejected from the ring (drain observed via "
+    "/healthz or a draining refusal on the wire)",
+)
+TUTORING_NODE_REJOINS = counter(
+    "tutoring_node_rejoins",
+    "ejected fleet members re-admitted to the ring (drain ended or an "
+    "operator joined them back); each rejoin starts a warm-up ramp so "
+    "the node's prefix cache refills before it takes its full key share",
+)
+TUTORING_FLEET_SIZE = gauge(
+    "tutoring_fleet_size",
+    "routable tutoring fleet members (configured minus ejected/draining)",
+)
+
 # Breaker state -> transition counter, used by the LMS breaker observer.
 # Living HERE keeps the mapping inside the declared namespace: the lint
 # rule treats any name expression rooted at this module as declared by
@@ -194,6 +229,17 @@ TTFT = histogram(
     "ttft",
     "engine-measured time between a request's prefill and its first "
     "decoded token",
+)
+TUTORING_DRAINING = gauge(
+    "tutoring_draining",
+    "1 while this tutoring node is draining (POST /admin/drain): new "
+    "requests are refused while in-flight work finishes and the fleet "
+    "router ejects the node from its ring",
+)
+TUTORING_DRAIN_REJECTIONS = counter(
+    "tutoring_drain_rejections",
+    "requests refused because this tutoring node was draining (the "
+    "router spills them to another fleet member)",
 )
 SHED_EXPIRED = counter(
     "shed_expired",

@@ -43,6 +43,14 @@ DEADLINE_METADATA_KEY = "x-deadline-budget-ms"
 # attempt used to queue duplicate instructor entries (ROADMAP item a).
 REQUEST_ID_METADATA_KEY = "x-request-id"
 
+# Trailing-metadata keys the tutoring node attaches to every answer: which
+# fleet member served it (threaded into the `tutoring.forward` span and
+# the routing pool's snapshots, so waterfalls and the ledger can attribute
+# answers), and the node's live serving-queue depth (a passive load signal
+# the router folds in between `/healthz` polls).
+SERVED_BY_METADATA_KEY = "x-served-by"
+QUEUE_DEPTH_METADATA_KEY = "x-queue-depth"
+
 
 def _metadata_value(metadata: Any, key: str) -> Optional[str]:
     """First value for `key` in a gRPC metadata sequence (pairs or a

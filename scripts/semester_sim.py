@@ -48,6 +48,10 @@ def main() -> int:
     ap.add_argument("--engine", choices=["echo", "tiny"], default=None,
                     help="tutoring engine: wire-complete echo stand-in "
                          "or the real tiny JAX engine")
+    ap.add_argument("--tutoring-nodes", type=int, default=None,
+                    help="tutoring fleet size behind the routing tier "
+                         "(> 1 adds the fleet drills: kill-one-of-N "
+                         "blackout, drain-and-rejoin, autoscale)")
     ap.add_argument("--no-events", action="store_true",
                     help="pure-workload run (no operations schedule)")
     ap.add_argument("--keep-workdir", action="store_true")
@@ -70,6 +74,8 @@ def main() -> int:
         overrides["base_rate"] = args.base_rate
     if args.engine is not None:
         overrides["tutoring_engine"] = args.engine
+    if args.tutoring_nodes is not None:
+        overrides["tutoring_nodes"] = args.tutoring_nodes
     if args.no_events:
         overrides["events"] = False
     if overrides:

@@ -67,6 +67,9 @@ _DASH_ROWS: Tuple[Tuple[str, str, str], ...] = (
     ("queue depth", "gauge", "serving_queue_depth"),
     ("prefix hit rate", "gauge", "prefix_cache_hit_rate"),
     ("megastep K", "gauge", "megastep_k"),
+    ("router spills/s", "rate", "tutoring_spills"),
+    ("hedge wins/s", "rate", "tutoring_hedge_wins"),
+    ("fleet size", "gauge", "tutoring_fleet_size"),
     ("answer p95 (s)", "p95", "answer_latency"),
     ("llm_ttft p95 (s)", "p95", "llm_ttft"),
     ("ttft p95 (s)", "p95", "ttft"),
@@ -103,6 +106,24 @@ def render_dashboard(scraper: ClusterScraper, window_s: float,
     if burn:
         pairs = "  ".join(f"{k}={v:.2f}" for k, v in sorted(burn.items()))
         out.write(f"  degraded-rate burn: {pairs}\n")
+    # Per-node rows: the scraper already keeps one timeline per source —
+    # with a tutoring fleet behind the router, per-member req/s, queue
+    # depth, and prefix hit rate are what drain/warm-up decisions (and
+    # post-mortems of a drill) read; the merged row above can't show a
+    # cold rejoined node refilling its cache.
+    if len(scraper.nodes) > 1:
+        out.write(f"  {'node':<14} {'req/s':>7} {'queue':>7} "
+                  f"{'tok/s':>7} {'hit':>7} {'p95 s':>7}\n")
+        for name in sorted(scraper.nodes):
+            ntl = scraper.nodes[name]
+            out.write(
+                f"  {name:<14}"
+                f" {_fmt(ntl.counter_rate('llm_requests', window_s))}"
+                f" {_fmt(ntl.gauge_last('serving_queue_depth'))}"
+                f" {_fmt(ntl.gauge_last('serving_tokens_per_s'))}"
+                f" {_fmt(ntl.gauge_last('prefix_cache_hit_rate'))}"
+                f" {_fmt(ntl.hist_p95('answer_latency', window_s))}\n"
+            )
     events = tl.events()
     for event in events[-3:]:
         out.write(f"  event: {event.get('kind')}: {event.get('detail')}\n")

@@ -31,6 +31,7 @@ from distributed_lms_raft_llm_tpu.utils import metrics_registry
 
 REPO = Path(__file__).resolve().parent.parent
 SERVICE = "distributed_lms_raft_llm_tpu/lms/service.py"
+POOL = "distributed_lms_raft_llm_tpu/lms/tutoring_pool.py"
 
 
 def test_tree_is_lint_clean():
@@ -135,22 +136,53 @@ def test_metadata_dropping_egress_fails_lint():
 
 def test_bare_metadata_egress_fails_lint():
     """The subtler break: metadata still flows (the deadline budget), but
-    without the wrapper the trace context is silently dropped."""
-    project = _project_with_patched_service(
-        "metadata=trace_metadata(\n"
-        "                            deadline.to_metadata()\n"
-        "                            if deadline is not None else None),",
-        "metadata=(\n"
-        "                            deadline.to_metadata()\n"
-        "                            if deadline is not None else None),",
+    without the wrapper the trace context is silently dropped. The
+    GetLLMAnswer forward now lives in the fleet router
+    (lms/tutoring_pool.py) — the pool is an egress-root module, so the
+    same revert fails lint there."""
+    project = _project_with_patch(
+        POOL, ("metadata=trace_metadata(md),", "metadata=md,")
     )
     findings = [
         f for f in TracePropagationRule().check_project(project)
-        if f.path == SERVICE and "GetLLMAnswer" in f.message
+        if f.path == POOL and "GetLLMAnswer" in f.message
     ]
     assert findings, (
         "an egress whose metadata bypasses trace_metadata() must fail "
         "trace-propagation"
+    )
+
+
+def test_pool_metadata_dropping_egress_fails_lint():
+    """Fleet-router pin: strip the metadata= keyword off the pool's
+    tutoring forward entirely and trace-propagation must catch it (the
+    x-served-by/waterfall chain would silently break)."""
+    project = _project_with_patch(
+        POOL, ("\n                    metadata=trace_metadata(md),", "")
+    )
+    findings = [
+        f for f in TracePropagationRule().check_project(project)
+        if f.path == POOL and "GetLLMAnswer" in f.message
+    ]
+    assert findings, (
+        "a pool egress that drops metadata= must fail trace-propagation"
+    )
+
+
+def test_pool_literal_timeout_fails_lint():
+    """Fleet-router pin: re-hardcoding the forward's timeout (dropping
+    the live Deadline budget) in tutoring_pool.py must fail
+    deadline-flow — the pool's async functions are rule roots even
+    though the call graph can't see `self.pool.forward`."""
+    project = _project_with_patch(
+        POOL, ("timeout=self._attempt_timeout(deadline),", "timeout=30,")
+    )
+    findings = [
+        f for f in DeadlineFlowRule().check_project(project)
+        if f.path == POOL
+    ]
+    assert findings, (
+        "a re-hardcoded pool forward timeout must fail deadline-flow"
     )
 
 

@@ -25,10 +25,12 @@ from distributed_lms_raft_llm_tpu.sim import (
 )
 
 # Deliberately small but not trivial: ~90 ops across 12 actors, every
-# event kind, and every SLO — in ~20 s of wall clock.
+# event kind (fleet drills included — 3 tutoring nodes behind the
+# cache-affinity router), and every SLO — in ~25 s of wall clock.
 TIER1_CFG = SimConfig(
     seed=7, students=10, instructors=2, courses=2,
     duration_s=16.0, base_rate=6.0, workers=6, llm_budget_s=10.0,
+    tutoring_nodes=3,
     slo_answer_p95_s=8.0, slo_degraded_rate_max=0.5,
     slo_tick_stalls_max=50,
 )
@@ -73,8 +75,32 @@ def test_sim_executed_every_event_kind(sim_run):
     assert not failed, f"events failed: {failed}"
     executed = record["events_executed"]
     for kind in ("rolling_restart", "quarantine", "membership_add",
-                 "membership_remove", "chaos_campaign"):
+                 "membership_remove", "chaos_campaign",
+                 "tutoring_blackout", "tutoring_drain_rejoin",
+                 "tutoring_autoscale"):
         assert executed.get(kind, 0) >= 1, f"missing event kind {kind}"
+
+
+def test_sim_fleet_drills_spilled_hedged_and_restored_affinity(sim_run):
+    """The tutoring-fleet acceptance: killing one of three tutoring
+    nodes mid-traffic left measured evidence — >=1 router spill and >=1
+    hedge win in the BENCH record — the drain-and-rejoin drill completed
+    (ejection + warm-up rejoin counted), and no node ended the run out
+    of the ring."""
+    record, _ = sim_run
+    fleet = record["tutoring_fleet"]
+    assert fleet is not None and fleet["size"] == 3
+    assert fleet["spills"] >= 1, fleet
+    assert fleet["hedges"] >= 1 and fleet["hedge_wins"] >= 1, fleet
+    assert fleet["ejections"] >= 1 and fleet["rejoins"] >= 1, fleet
+    checks = record["slos"]["checks"]
+    assert checks["fleet_spill_observed"]["ok"]
+    assert checks["fleet_hedge_win_observed"]["ok"]
+    assert checks["fleet_nodes_routable"]["ok"]
+    # The per-node map survived to the verdict: every configured node
+    # routable, with route/served attribution.
+    states = {n["state"] for n in fleet["nodes"]}
+    assert states <= {"ok", "warming"}, fleet["nodes"]
 
 
 def test_sim_exercised_degraded_path(sim_run):
