@@ -447,6 +447,170 @@ def bench_sweep(model: str = "gpt2", tp: int = 1, quant: bool = False,
     return records
 
 
+def bench_score_scenario(model: str = "gpt2", tp: int = 1,
+                         quant: bool = False, slots: int = BATCH,
+                         chunk: int = 16, megastep: int = 1,
+                         megastep_max: int = 0, inflight: int = 2,
+                         interactive: int = 24, arrival_s: float = 0.03,
+                         score_texts_n: int = 128,
+                         score_text_tokens: int = 48,
+                         max_new: int = MAX_NEW,
+                         prompt_len: int = PROMPT_LEN,
+                         length_buckets=None, greedy: bool = False) -> dict:
+    """The two-tenant scenario: interactive load with the background
+    scoring tenant OFF then ON, through the real PagedQueue co-scheduler.
+
+    Phase OFF drives `interactive` requests at `arrival_s` spacing and
+    records interactive tokens/s + TTFT p90. Phase ON replays the same
+    arrivals with a `score_texts_n`-text bulk job submitted up front:
+    quanta harvest the idle lanes (arrival gaps + the post-workload
+    drain). The acceptance claims the record must witness: total
+    tokens/s/chip RISES with the tenant on (the harvest), interactive
+    p90 TTFT HOLDS (quanta admit only while nothing interactive is
+    pending — `quanta_with_pending` stays 0 and every preemption wait is
+    bounded by one quantum), and the warmed score domain means ZERO live
+    compiles (EngineConfig.scoring warms it; the engine is reused across
+    both phases so phase ON compiles nothing).
+    """
+    import asyncio
+
+    import jax
+
+    from distributed_lms_raft_llm_tpu.engine import (
+        EngineConfig,
+        PagedEngine,
+        PagedQueue,
+        SamplingParams,
+        ScoringManager,
+    )
+    from distributed_lms_raft_llm_tpu.utils.metrics import Metrics
+
+    n_chips = max(1, len(jax.devices()))
+    artifacts = ensure_local_artifacts() if model == "gpt2" else {}
+    sampling = (
+        SamplingParams.greedy(max_new_tokens=max_new) if greedy
+        else SamplingParams.reference_defaults(max_new_tokens=max_new)
+    )
+    engine = PagedEngine(
+        EngineConfig(
+            model=model,
+            sampling=sampling,
+            length_buckets=tuple(length_buckets or (prompt_len, 64, 128)),
+            batch_buckets=tuple(sorted({1, 2, 4, 8, min(8, slots)})),
+            tp=tp,
+            quant="int8" if quant else None,
+            kv_quant=quant,
+            scoring=True,
+            **artifacts,
+        ),
+        slots=slots, chunk=chunk, inflight=inflight,
+        megastep=megastep, megastep_max=megastep_max,
+    )
+    compile_s = engine.warmup()
+    rng = np.random.default_rng(0)
+    prompts = [
+        engine.tokenizer.decode(
+            rng.integers(0, engine.tokenizer.vocab_size, prompt_len).tolist()
+        )
+        for _ in range(interactive)
+    ]
+    corpus = [
+        engine.tokenizer.decode(
+            rng.integers(0, engine.tokenizer.vocab_size,
+                         score_text_tokens).tolist()
+        )
+        for _ in range(score_texts_n)
+    ]
+
+    async def phase(with_scoring: bool) -> dict:
+        metrics = Metrics()
+        scorer = (ScoringManager(engine, metrics=metrics,
+                                 max_job_texts=len(corpus))
+                  if with_scoring else None)
+        queue = PagedQueue(engine, metrics=metrics, scorer=scorer)
+        await queue.start()
+        engine.total_generated_tokens = 0
+        t0 = time.monotonic()
+        if scorer is not None:
+            scorer.submit(corpus, purpose="calibration")
+        tasks = []
+        for p in prompts:
+            tasks.append(asyncio.ensure_future(queue.submit(p)))
+            await asyncio.sleep(arrival_s)
+        await asyncio.gather(*tasks)
+        interactive_s = time.monotonic() - t0
+        interactive_tokens = engine.total_generated_tokens
+        if scorer is not None:
+            # Drain the bulk backlog: pure idle-lane time from here on.
+            while not scorer.done():
+                await asyncio.sleep(0.01)
+        elapsed = time.monotonic() - t0
+        p90 = metrics.hist("ttft").percentile(90) or 0.0
+        snap = metrics.snapshot()
+        stats = scorer.stats() if scorer is not None else {}
+        out = dict(
+            interactive_s=interactive_s,
+            elapsed_s=elapsed,
+            interactive_tokens=interactive_tokens,
+            scored_tokens=stats.get("scored_tokens", 0),
+            ttft_p90_ms=p90 * 1000.0,
+            quanta=stats.get("quanta", 0),
+            jobs_completed=stats.get("jobs_completed", 0),
+            quanta_with_pending=stats.get("quanta_with_pending", 0),
+            max_quantum_wall_ms=stats.get("max_quantum_wall_ms", 0.0),
+            preempt_wait_ms=snap.get("counters", {}).get(
+                "score_preempt_wait_ms", 0
+            ),
+            max_preempt_wait_ms=queue.max_preempt_wait_s * 1000.0,
+        )
+        await queue.close()
+        return out
+
+    off = asyncio.run(phase(False))
+    on = asyncio.run(phase(True))
+    total_off = off["interactive_tokens"] / off["elapsed_s"] / n_chips
+    total_on = (
+        (on["interactive_tokens"] + on["scored_tokens"])
+        / on["elapsed_s"] / n_chips
+    )
+    return {
+        "metric": "paged_score_tenant_total_tokens_per_sec_per_chip",
+        "value": round(total_on, 2),
+        "unit": "tokens/sec/chip",
+        "interactive_requests": interactive,
+        "arrival_s": arrival_s,
+        "score_texts": score_texts_n,
+        "interactive_tokens_per_sec_per_chip_off": round(
+            off["interactive_tokens"] / off["elapsed_s"] / n_chips, 2
+        ),
+        "interactive_tokens_per_sec_per_chip_on": round(
+            on["interactive_tokens"] / on["interactive_s"] / n_chips, 2
+        ),
+        "total_tokens_per_sec_per_chip_off": round(total_off, 2),
+        "total_tokens_per_sec_per_chip_on": round(total_on, 2),
+        "ttft_p90_ms_off": round(off["ttft_p90_ms"], 2),
+        "ttft_p90_ms_on": round(on["ttft_p90_ms"], 2),
+        "ttft_p90_delta_ms": round(
+            on["ttft_p90_ms"] - off["ttft_p90_ms"], 2
+        ),
+        "scoring_quanta": on["quanta"],
+        "scoring_jobs_completed": on["jobs_completed"],
+        "scored_tokens": on["scored_tokens"],
+        # The admission-policy witnesses: quanta admitted while anything
+        # interactive waited (must be 0), and the worst single wait an
+        # interactive arrival paid for an in-flight quantum (bounded by
+        # one quantum wall).
+        "quanta_with_pending": on["quanta_with_pending"],
+        "max_quantum_wall_ms": on["max_quantum_wall_ms"],
+        "score_preempt_wait_ms": on["preempt_wait_ms"],
+        "max_preempt_wait_ms": round(on["max_preempt_wait_ms"], 2),
+        "slots": slots,
+        "chunk": chunk,
+        "compile_s": round(compile_s, 1),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def bench_torch_baseline(model: str = "gpt2", budget_new_tokens: int = 32) -> float:
     """Reference path: torch-CPU GPT-2 (matching size), sequential queries."""
     arch = {
@@ -554,6 +718,17 @@ def main() -> None:
                          "most-recent n-gram continuation; ngram = per-slot "
                          "modal-continuation table (higher acceptance at "
                          "temperature>0)")
+    ap.add_argument("--score-scenario", action="store_true",
+                    help="paged: run the two-tenant scenario (interactive "
+                         "load with the background scoring tenant off "
+                         "then on) and print its BENCH record — total "
+                         "tok/s/chip must rise, interactive p90 TTFT "
+                         "must hold, quanta_with_pending must be 0")
+    ap.add_argument("--score-texts", type=int, default=128,
+                    help="bulk-job corpus size for --score-scenario")
+    ap.add_argument("--score-interactive", type=int, default=24,
+                    help="interactive requests per phase for "
+                         "--score-scenario")
     ap.add_argument("--prefix-scenario", action="store_true",
                     help="paged: also run the shared-prefix scenario (N "
                          "requests against one common course context, "
@@ -572,6 +747,16 @@ def main() -> None:
         if args.tp == 1:
             args.tp = t.tp
     extra = dict(spec_tokens=args.spec_tokens, greedy=args.greedy)
+    if args.score_scenario:
+        record = bench_score_scenario(
+            args.model, args.tp, quant=args.tp == 1, slots=args.batch,
+            chunk=args.chunk, megastep=args.megastep,
+            megastep_max=args.megastep_max, inflight=args.inflight,
+            interactive=args.score_interactive,
+            score_texts_n=args.score_texts, greedy=args.greedy,
+        )
+        print(json.dumps(record))
+        return
     if args.sweep:
         grid = bench_sweep(
             args.model, args.tp, quant=args.tp == 1,

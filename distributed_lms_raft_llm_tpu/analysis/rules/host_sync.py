@@ -28,6 +28,10 @@ DISPATCH_MODULES = (
     "engine/paged.py",
     "engine/engine.py",
     "engine/draft.py",
+    # The scoring tenant's quantum loop shares the serving chip: a bare
+    # .item()/np.asarray there stalls interactive dispatch exactly like
+    # a decode-path sync would.
+    "engine/scoring.py",
 )
 
 _SYNC_ATTR_CALLS = {"item", "tolist", "block_until_ready"}

@@ -178,6 +178,28 @@ class TutoringFleetConfig:
 
 
 @dataclasses.dataclass
+class ScoringConfig:
+    """[scoring] — the background bulk-scoring tenant on the tutoring
+    node (engine/scoring.py). One section because the knobs compose into
+    one policy: `enabled` makes the score program warmup-covered (the
+    first instructor bulk job pays zero live XLA compiles) and starts
+    the co-scheduled tenant (quanta run only while the interactive
+    pending queue is empty, yielding at single-dispatch boundaries);
+    the caps bound what one admin POST can park on the chip and how
+    much finished-job state `GET /admin/score` retains."""
+
+    enabled: bool = False
+    max_job_texts: int = 4096   # admission cap per bulk job (texts)
+    jobs_retained: int = 32     # finished jobs kept for GET /admin/score
+
+    def __post_init__(self) -> None:
+        if self.max_job_texts < 1 or self.jobs_retained < 1:
+            raise ValueError(
+                "[scoring] needs max_job_texts >= 1 and jobs_retained >= 1"
+            )
+
+
+@dataclasses.dataclass
 class GateConfig:
     """[gate] — the BERT relevance gate on the LMS leader."""
 
@@ -308,6 +330,13 @@ class SimConfig:
     #                               ContinuousSloEngine over a live cluster
     #                               scrape), not only at run end; alerts
     #                               land in the verdict and the BENCH record
+    bulk_scoring: bool = True     # run the "bulk grading night" event: an
+    #                               instructor-scale score job fanned to the
+    #                               tutoring fleet mid-run via the LMS
+    #                               admin plane; the background tenant must
+    #                               complete it WITHOUT moving interactive
+    #                               p95 (a scoring-induced burn alert is a
+    #                               false alarm — it fails the verdict)
     telemetry_sample_s: float = 0.25  # scrape/evaluate cadence of the
     #                               in-run telemetry loop (cluster /metrics
     #                               poll + burn-rate evaluation)
@@ -411,6 +440,7 @@ class AppConfig:
         default_factory=TutoringFleetConfig
     )
     sampling: SamplingConfig = dataclasses.field(default_factory=SamplingConfig)
+    scoring: ScoringConfig = dataclasses.field(default_factory=ScoringConfig)
     gate: GateConfig = dataclasses.field(default_factory=GateConfig)
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
@@ -443,8 +473,8 @@ def load_config(path: str) -> AppConfig:
     with open(path, "rb") as fh:
         raw = tomllib.load(fh)
     unknown = set(raw) - {"cluster", "tutoring", "tutoring_fleet",
-                          "sampling", "gate", "resilience", "storage",
-                          "sim", "tracing", "telemetry"}
+                          "sampling", "scoring", "gate", "resilience",
+                          "storage", "sim", "tracing", "telemetry"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -463,6 +493,8 @@ def load_config(path: str) -> AppConfig:
                               "tutoring_fleet"),
         sampling=_build(SamplingConfig, dict(raw.get("sampling", {})),
                         "sampling"),
+        scoring=_build(ScoringConfig, dict(raw.get("scoring", {})),
+                       "scoring"),
         gate=_build(GateConfig, dict(raw.get("gate", {})), "gate"),
         resilience=_build(ResilienceConfig, dict(raw.get("resilience", {})),
                           "resilience"),
@@ -552,6 +584,7 @@ def engine_config(cfg: AppConfig):
         sampling=sampling_params(cfg), tp=t.tp, ep=t.ep, quant=t.quant,
         kv_quant=t.kv_quant, spec_tokens=t.spec_tokens,
         draft_source=t.draft_source,
+        scoring=cfg.scoring.enabled,
     )
 
 

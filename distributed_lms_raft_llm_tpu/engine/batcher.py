@@ -18,6 +18,17 @@ an unbounded backlog whose tail nobody is still waiting for. Requests may
 carry a `Deadline`; one that expires while queued is dropped *before* its
 prefill is dispatched (counter `shed_expired`), so a saturated chip only
 computes answers that can still be delivered.
+
+Two-tenant scheduling (both queues): with a `ScoringManager`
+(engine/scoring.py) attached, the runner co-schedules background bulk
+scoring into idle lanes — Orca-style iteration-level scheduling decides
+*per dispatch* what runs. A scoring quantum (one batch-bucket forward) is
+admitted ONLY while the interactive pending queue is empty and the engine
+holds no in-flight work, and the runner re-checks interactive arrivals at
+every quantum boundary, so an interactive request waits behind at most
+one in-flight quantum (the wait lands in `score_preempt_wait_ms`).
+Interactive traffic never queues behind bulk work; bulk work drains the
+idle gap between the serving load and the chip's saturation ceiling.
 """
 
 from __future__ import annotations
@@ -31,15 +42,16 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from ..utils import metrics_registry as metric
 from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
-from ..utils.tracing import FLAG_DEADLINE, NULL_SPAN
+from ..utils.tracing import FLAG_DEADLINE, NULL_SPAN, get_tracer
 
 log = logging.getLogger(__name__)
 
 # Queue items: (prompt, deadline-or-None, result future, request span,
-# its open queue.wait child). Spans are NULL_SPAN when the request
-# entered through an untraced edge, so the scheduling code never
-# branches on tracing.
-_Item = Tuple[str, Optional[Deadline], asyncio.Future, Any, Any]
+# its open queue.wait child, monotonic enqueue time). Spans are NULL_SPAN
+# when the request entered through an untraced edge, so the scheduling
+# code never branches on tracing; the enqueue time feeds the scoring
+# tenant's preemption-wait account (score_preempt_wait_ms).
+_Item = Tuple[str, Optional[Deadline], asyncio.Future, Any, Any, float]
 
 
 def _observe_program_times(metrics, entries) -> None:
@@ -55,6 +67,65 @@ def _observe_program_times(metrics, entries) -> None:
             ).observe(wall_s)
 
 
+async def _run_score_quantum(owner) -> None:
+    """Dispatch ONE background-scoring quantum off-loop and record its
+    window. Shared by both queues; called only while the interactive
+    pending queue is empty and the engine is idle — the admission policy
+    the scoring tenant promises. The engine's `score` program time is
+    drained into the `engine_prog_score` histogram here (there is no
+    request batch to attribute it to)."""
+    scorer = owner._scorer
+    loop = asyncio.get_running_loop()
+    t0 = time.monotonic()
+    with get_tracer().span("scoring.quantum",
+                           job=scorer.current_job_id() or "") as sp:
+        did = await loop.run_in_executor(
+            None, scorer.run_quantum, owner.waiting
+        )
+        sp.set_attr("did_work", bool(did))
+    # The quantum window: interactive arrivals inside it waited for the
+    # boundary; _note_preempt charges them to score_preempt_wait_ms.
+    owner._last_quantum = (t0, time.monotonic())
+    pop = getattr(owner.engine, "pop_program_times", None)
+    if pop is not None:
+        _observe_program_times(owner.metrics, pop())
+
+
+async def _next_item(owner, incoming: asyncio.Queue) -> Optional[_Item]:
+    """The two-tenant idle wait: interactive work first, always; a
+    scoring quantum only when none is pending; block on BOTH arrival
+    sources otherwise. Returns an interactive item, or None after a
+    scoring round (the caller loops — arrivals are re-checked at every
+    quantum boundary, so nothing waits behind more than one quantum)."""
+    if not incoming.empty():
+        return incoming.get_nowait()
+    scorer = owner._scorer
+    if scorer is None:
+        return await incoming.get()
+    if scorer.has_work:
+        await _run_score_quantum(owner)
+        return None
+    getter = asyncio.ensure_future(incoming.get())
+    waker = asyncio.ensure_future(scorer.wake_event().wait())
+    try:
+        await asyncio.wait({getter, waker},
+                           return_when=asyncio.FIRST_COMPLETED)
+    finally:
+        # An un-popped item survives getter cancellation (asyncio.Queue
+        # re-wakes the next getter); the wake flag is level-triggered.
+        for t in (getter, waker):
+            if not t.done():
+                t.cancel()
+        await asyncio.gather(getter, waker, return_exceptions=True)
+    if getter.done() and not getter.cancelled() and (
+        getter.exception() is None
+    ):
+        # Already-done asyncio.Task: result() is immediate.
+        return getter.result()  # lint: disable=no-blocking-in-async
+    scorer.clear_wake()
+    return None
+
+
 class BatchingQueue:
     """Coalesces submit() calls into engine.answer_batch() invocations."""
 
@@ -65,12 +136,18 @@ class BatchingQueue:
         max_wait_ms: float = 10.0,
         metrics=None,
         max_queue: int = 0,
+        scorer=None,
     ):
         self.engine = engine
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1000.0
         self.metrics = metrics
         self.max_queue = max_queue  # 0 = unbounded (legacy behavior)
+        # Background scoring tenant (engine/scoring.ScoringManager or
+        # None): quanta run only while no interactive request waits.
+        self._scorer = scorer
+        self._last_quantum: Optional[Tuple[float, float]] = None  # guarded-by: event-loop
+        self.max_preempt_wait_s = 0.0                # guarded-by: event-loop
         # Loop-confined state: everything below is touched only from
         # coroutines on the serving loop — the engine call is the ONLY
         # thing that leaves the loop (run_in_executor), and it receives
@@ -105,7 +182,7 @@ class BatchingQueue:
         # Fail fast for anything still waiting (queued requests, or a group
         # whose device batch was cancelled mid-flight) instead of hanging.
         while not self._queue.empty():
-            _, _, fut, _, qspan = self._queue.get_nowait()
+            _, _, fut, _, qspan, _ = self._queue.get_nowait()
             qspan.end()
             if not fut.done():
                 fut.set_exception(RuntimeError("batching queue closed"))
@@ -137,13 +214,13 @@ class BatchingQueue:
         span = span if span is not None else NULL_SPAN
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._queue.put(
-            (prompt, deadline, fut, span, span.child("queue.wait"))
+            (prompt, deadline, fut, span, span.child("queue.wait"),
+             time.monotonic())
         )
         return await fut
 
-    async def _collect(self) -> List[_Item]:
-        """Block for the first request, then gather companions briefly."""
-        first = await self._queue.get()
+    async def _collect(self, first: _Item) -> List[_Item]:
+        """Gather companions for the (already-popped) first request."""
         group = [first]
         deadline = time.monotonic() + self.max_wait_s
         while len(group) < self.max_batch:
@@ -163,7 +240,7 @@ class BatchingQueue:
         exact device time an overloaded server is short of."""
         live: List[_Item] = []
         for item in group:
-            _, dl, fut, span, qspan = item
+            _, dl, fut, span, qspan, _ = item
             if dl is not None and dl.expired:
                 self._inc("shed_expired")
                 qspan.end()
@@ -176,12 +253,30 @@ class BatchingQueue:
                 live.append(item)
         return live
 
+    def _note_preempt(self, t_enq: float) -> None:
+        """Charge an interactive arrival that landed inside the last
+        scoring quantum's window the wait it paid for the boundary."""
+        if self._last_quantum is None:
+            return
+        q0, q1 = self._last_quantum
+        if q0 <= t_enq < q1:
+            wait_s = q1 - t_enq
+            self.max_preempt_wait_s = max(self.max_preempt_wait_s, wait_s)
+            if self.metrics is not None:
+                self.metrics.inc("score_preempt_wait_ms",
+                                 max(1, int(wait_s * 1000.0)))
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            group = self._drop_expired(await self._collect())
+            first = await _next_item(self, self._queue)
+            if first is None:
+                continue  # a scoring quantum ran; re-check arrivals
+            group = self._drop_expired(await self._collect(first))
             if not group:
                 continue  # everything expired while queued: zero prefills
+            for item in group:
+                self._note_preempt(item[5])
             if self.metrics is not None:
                 # Admission pressure at dispatch time: what is STILL
                 # waiting once this group leaves the queue (the telemetry
@@ -189,12 +284,12 @@ class BatchingQueue:
                 # signal for the capacity model).
                 self.metrics.set_gauge("serving_queue_depth",
                                        float(self.waiting))
-            prompts = [p for p, _, _, _, _ in group]
+            prompts = [p for p, _, _, _, _, _ in group]
             # Dispatch moment: queue.wait ends, engine.batch begins, for
             # every request of the group (per-request spans under each
             # request's own parent; the device batch is shared).
             espans = []
-            for _, _, _, span, qspan in group:
+            for _, _, _, span, qspan, _ in group:
                 qspan.end()
                 espans.append(
                     span.child("engine.batch", batch=len(group))
@@ -216,7 +311,7 @@ class BatchingQueue:
                     pop()
                 for espan in espans:
                     espan.end()
-                for _, _, fut, _, _ in group:
+                for _, _, fut, _, _, _ in group:
                     if not fut.done():
                         fut.set_exception(RuntimeError("batching queue closed"))
                 raise
@@ -228,7 +323,7 @@ class BatchingQueue:
                 # spans (they happened here) — leaving them queued would
                 # misattribute them to the next batch's traces.
                 self._finish_engine_spans(espans, t_batch_unix)
-                for _, _, fut, _, _ in group:
+                for _, _, fut, _, _, _ in group:
                     if not fut.done():
                         fut.set_exception(e)
                 continue
@@ -248,7 +343,7 @@ class BatchingQueue:
                     # verify window (1.0 = nothing accepted). A gauge —
                     # it is a ratio, not a latency.
                     self.metrics.set_gauge("spec_tokens_per_window", tpw)
-            for (_, _, fut, _, _), answer in zip(group, answers):
+            for (_, _, fut, _, _, _), answer in zip(group, answers):
                 if not fut.done():
                     fut.set_result(answer)
 
@@ -311,10 +406,18 @@ class PagedQueue:
     a time — reference: GUI_RAFT_LLM_SourceCode/tutoring_server.py:21-29).
     """
 
-    def __init__(self, engine, metrics=None, max_queue: int = 0):
+    def __init__(self, engine, metrics=None, max_queue: int = 0,
+                 scorer=None):
         self.engine = engine
         self.metrics = metrics
         self.max_queue = max_queue  # bound on not-yet-admitted requests
+        # Background scoring tenant (engine/scoring.ScoringManager or
+        # None): quanta run only while nothing interactive is pending
+        # AND the engine holds no in-flight decode work (the outer loop
+        # only reaches the idle wait once has_work is False).
+        self._scorer = scorer
+        self._last_quantum: Optional[Tuple[float, float]] = None  # guarded-by: event-loop
+        self.max_preempt_wait_s = 0.0                # guarded-by: event-loop
         # Loop-confined (see BatchingQueue): the engine's step() runs in an
         # executor thread, but it never sees these containers — admissions
         # and reaps happen on the runner coroutine between steps.
@@ -371,7 +474,7 @@ class PagedQueue:
                 pass
             self._runner = None
         while not self._incoming.empty():
-            _, _, fut, _, qspan = self._incoming.get_nowait()
+            _, _, fut, _, qspan, _ = self._incoming.get_nowait()
             qspan.end()
             if not fut.done():
                 fut.set_exception(RuntimeError("paged queue closed"))
@@ -400,12 +503,28 @@ class PagedQueue:
         span = span if span is not None else NULL_SPAN
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._incoming.put(
-            (prompt, deadline, fut, span, span.child("queue.wait"))
+            (prompt, deadline, fut, span, span.child("queue.wait"),
+             time.monotonic())
         )
         return await fut
 
+    def _note_preempt(self, t_enq: float) -> None:
+        """Charge an interactive arrival that landed inside the last
+        scoring quantum's window the wait it paid for the boundary."""
+        if self._last_quantum is None:
+            return
+        q0, q1 = self._last_quantum
+        if q0 <= t_enq < q1:
+            wait_s = q1 - t_enq
+            self.max_preempt_wait_s = max(self.max_preempt_wait_s, wait_s)
+            if self.metrics is not None:
+                self.metrics.inc("score_preempt_wait_ms",
+                                 max(1, int(wait_s * 1000.0)))
+
     def _admit(self, prompt: str, deadline: Optional[Deadline],
-               fut: asyncio.Future, span: Any, qspan: Any) -> None:
+               fut: asyncio.Future, span: Any, qspan: Any,
+               t_enq: float) -> None:
+        self._note_preempt(t_enq)
         # Shed before prefill: a queue-expired request never enters the
         # engine (its prefill chunk is the expensive step).
         if deadline is not None and deadline.expired:
@@ -430,8 +549,8 @@ class PagedQueue:
 
     def _drain_incoming(self) -> None:
         while not self._incoming.empty():
-            prompt, deadline, fut, span, qspan = self._incoming.get_nowait()
-            self._admit(prompt, deadline, fut, span, qspan)
+            item = self._incoming.get_nowait()
+            self._admit(*item)
 
     def _shed_expired_pending(self) -> None:
         """Requests that expired while backlogged in the engine's pending
@@ -464,10 +583,17 @@ class PagedQueue:
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            # Idle: block until a request arrives, then admit it plus any
-            # companions that queued behind it.
-            prompt, deadline, fut, span, qspan = await self._incoming.get()
-            self._admit(prompt, deadline, fut, span, qspan)
+            # Idle: block until a request arrives (or, with the scoring
+            # tenant attached, run one background quantum per round and
+            # re-check arrivals at its boundary), then admit the request
+            # plus any companions that queued behind it. Scoring only
+            # ever runs HERE — the engine holds no in-flight interactive
+            # work at the idle wait, so a quantum never competes with a
+            # live decode train.
+            item = await _next_item(self, self._incoming)
+            if item is None:
+                continue  # a scoring quantum ran; arrivals re-checked
+            self._admit(*item)
             while self.engine.has_work:
                 self._drain_incoming()
                 self._shed_expired_pending()

@@ -37,6 +37,7 @@ from ..utils.compilation import enable_compilation_cache
 from ..utils.guards import intended_transfer
 from .generate import GenerateResult, decode, pick_bucket, prefill
 from .sampling import SamplingParams
+from .scoring import _score_program, derive_score_shapes, score_texts
 
 log = logging.getLogger(__name__)
 
@@ -103,6 +104,13 @@ class EngineConfig:
     # transcript); TutoringEngine rejects it rather than silently
     # drafting differently than configured.
     draft_source: str = "prompt_lookup"
+    # Background bulk-scoring tenant (engine/scoring.py): when True,
+    # warmup compiles the score program over its full (batch bucket x
+    # length bucket) domain — `expected_from_inventory` then asserts the
+    # set exactly, so the first instructor bulk job pays zero live XLA
+    # compiles. score() works either way; off just means on-demand
+    # compilation (a bench/offline convenience, never the serving path).
+    scoring: bool = False
     dtype: Any = jnp.bfloat16
     # Serving stores weights in bf16: halves the HBM read per decode step
     # versus f32 (the decode loop is memory-bound — every step streams all
@@ -259,7 +267,27 @@ class TutoringEngine:
         # series and `engine.<program>` trace spans (bounded; see
         # PagedEngine._prog_times for the paged counterpart).
         self._prog_times: List[Tuple[str, float, float]] = []
-        self._score_fn = None  # built lazily on first score() call
+        # Bulk-scoring program (engine/scoring.py): bound at construction
+        # like every other program — no lazy first-call compile hiding on
+        # the serving path. With sp > 1 the forward runs as ring
+        # attention over sequence shards (cfg.ring_mesh).
+        score_cfg = self.cfg
+        if config.sp > 1:
+            score_cfg = dataclasses.replace(score_cfg, ring_mesh=self.mesh)
+        self._score = jax.jit(
+            partial(_score_program, cfg=score_cfg, model=self.family)
+        )
+        # The score domain warmup covers when `config.scoring` is on —
+        # cross-checked against program_inventory.static_score_domain by
+        # expected_from_inventory, so the mirror cannot rot.
+        self.score_shapes: List[Tuple[int, int]] = (
+            derive_score_shapes(
+                config.length_buckets, config.batch_buckets,
+                self.cfg.max_position_embeddings, sp=config.sp,
+                dp=self.mesh.shape.get("dp", 1),
+            )
+            if config.scoring else []
+        )
 
     _PROG_TIMES_MAX = 1024
 
@@ -342,6 +370,9 @@ class TutoringEngine:
         ids = np.zeros((batch, bucket), np.int32)
         mask = np.ones((batch, bucket), bool)
         self.generate_ids(ids, mask)
+        # Scoring-tenant domain (empty unless EngineConfig.scoring): the
+        # first bulk job must not eat an XLA compile on the serving path.
+        self._warm_score()
         return time.monotonic() - t0
 
     def generate_ids(
@@ -406,112 +437,44 @@ class TutoringEngine:
         with intended_transfer():  # the call's one sanctioned readback
             return jax.device_get(result)
 
+    @property
+    def score_batch_cap(self) -> int:
+        """Texts per single-dispatch score quantum (the largest batch
+        bucket) — the scoring tenant's preemption granularity."""
+        return max(self.config.batch_buckets)
+
     def score(self, texts: Sequence[str]) -> List[dict]:
         """Log-likelihood scoring: per text, the total next-token log
-        probability, token count, and perplexity under the model.
+        probability, token count, perplexity, and a `truncated` flag
+        (True when the text exceeded the length-bucket limit and only
+        its prefix was scored — relevance evals must not read a prefix
+        score as a full-document score).
 
         Runs the FULL-SEQUENCE forward (no cache) — the long-context
         direction: with `EngineConfig.sp > 1` the attention runs as ring
         attention over sequence shards (parallel/ring.py), so documents
-        far beyond a single chip's attention budget score across the mesh.
-        Texts are right-padded to a power-of-two bucket (pads sit after
-        the causal horizon of every real token and are masked out of the
-        sum). Groups larger than the biggest batch bucket run as several
-        device batches. No reference counterpart — the reference cannot
-        evaluate model fit at all; this is what `bench`/gate-threshold
-        tuning and course-material relevance evals build on.
-
-        MoE caveat: with capacity dropping active (capacity_factor <
-        num_experts) a token's routing — hence its logprob — depends on
-        its forward-pass companions, pads and filler rows included
-        (models/moe.py). For reproducible MoE evals raise
-        capacity_factor to >= num_experts.
+        far beyond a single chip's attention budget score across the
+        mesh. Groups larger than the biggest batch bucket run as several
+        device batches (engine/scoring.py holds the implementation; the
+        `_score` program is bound at construction and warmup-covered
+        when `EngineConfig.scoring` is on). No reference counterpart —
+        the reference cannot evaluate model fit at all; bulk grading,
+        gate-threshold calibration, and course-material relevance evals
+        build on this.
         """
-        if not texts:
-            return []
-        cap = max(self.config.batch_buckets)
-        if len(texts) > cap:
-            out: List[dict] = []
-            for start in range(0, len(texts), cap):
-                out.extend(self.score(texts[start : start + cap]))
-            return out
-        limit = min(
-            max(self.config.length_buckets),
-            self.cfg.max_position_embeddings,
-        )
-        if self.config.sp > 1:
-            # The bucket below is rounded UP to a multiple of sp; floor the
-            # limit to a multiple first so the rounded bucket can never
-            # exceed the position table (JAX would clamp the wpe gather
-            # silently and score garbage positions).
-            limit = (limit // self.config.sp) * self.config.sp
-        token_lists = []
-        for text in texts:
-            toks = self.tokenizer.encode(text)[:limit]
-            token_lists.append(toks if toks else [self.tokenizer.pad_id])
-        longest = max(len(t) for t in token_lists)
-        bucket = pick_bucket(longest, self.config.length_buckets)
-        bucket = min(bucket, limit)
-        if self.config.sp > 1:
-            # Ring attention consumes the sequence in sp equal shards; the
-            # sp-floored `limit` above guarantees this stays <= the
-            # position table.
-            bucket = min(
-                ((bucket + self.config.sp - 1) // self.config.sp
-                 ) * self.config.sp,
-                limit,
-            )
-        nbatch = pick_bucket(len(texts), self.config.batch_buckets)
-        if self.config.sp > 1:
-            # Ring attention shard_maps over the mesh: the batch must tile
-            # dp exactly (filler rows are all-pad, scored then dropped).
-            dp = self.mesh.shape.get("dp", 1)
-            nbatch = ((nbatch + dp - 1) // dp) * dp
-        ids = np.full((nbatch, bucket), self.tokenizer.pad_id, np.int32)
-        mask = np.zeros((nbatch, bucket), bool)
-        for i, toks in enumerate(token_lists):
-            ids[i, : len(toks)] = toks
-            mask[i, : len(toks)] = True
+        return score_texts(self, texts)
 
-        if self._score_fn is None:
-            import dataclasses as _dc
-
-            cfg = self.cfg
-            if self.config.sp > 1:
-                cfg = _dc.replace(cfg, ring_mesh=self.mesh)
-            family = self.family
-
-            def score_fn(params, ids, mask):
-                logits, *_ = family.forward(params, cfg, ids)
-                logp = jax.nn.log_softmax(logits.astype(jnp.float32),
-                                          axis=-1)
-                picked = jnp.take_along_axis(
-                    logp[:, :-1], ids[:, 1:, None], axis=-1
-                )[..., 0]
-                valid = mask[:, 1:] & mask[:, :-1]
-                total = jnp.sum(
-                    jnp.where(valid, picked, 0.0), axis=1
-                )
-                count = jnp.sum(valid, axis=1)
-                return total, count
-
-            self._score_fn = jax.jit(score_fn)
-
-        with self.mesh, intended_transfer():
-            total, count = jax.device_get(
-                self._score_fn(self.params, jnp.asarray(ids),
-                               jnp.asarray(mask))
-            )
-        out = []
-        for i in range(len(texts)):
-            n = int(count[i])
-            lp = float(total[i])
-            out.append({
-                "logprob": lp,
-                "tokens": n,
-                "ppl": float(np.exp(-lp / max(n, 1))),
-            })
-        return out
+    def _warm_score(self) -> int:
+        """Compile the score program over its full (batch bucket x
+        length bucket) domain so the first bulk job pays zero live XLA
+        compiles; a no-op (empty domain) when scoring is disabled."""
+        for nb, bucket in self.score_shapes:
+            ids = np.full((nb, bucket), self.tokenizer.pad_id, np.int32)
+            mask = np.ones((nb, bucket), bool)
+            with self.mesh:
+                self._score(self.params, jnp.asarray(ids),
+                            jnp.asarray(mask))
+        return len(self.score_shapes)
 
     def answer_batch(self, prompts: Sequence[str]) -> List[str]:
         """The serving entry: prompts in, decoded answers out.

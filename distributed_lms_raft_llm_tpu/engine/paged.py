@@ -111,6 +111,7 @@ from .prefix_cache import (
     plan_staged,
 )
 from .program_inventory import effective_megastep_max, megastep_ladder
+from .scoring import _score_program, derive_score_shapes, score_texts
 from .sampling import (
     SamplingParams,
     sample_step,
@@ -1151,6 +1152,21 @@ class PagedEngine:
             partial(_grow_state_program), static_argnums=(1,),
             donate_argnums=(0,),
         )
+        # Bulk-scoring program (engine/scoring.py): the background
+        # tenant's full-sequence forward, bound per engine like every
+        # other program (fresh partial = fresh cache — the _grow
+        # precedent). Zero warmed programs when `config.scoring` is off
+        # (the stable-program-set precedent of _megastep/_stage).
+        self._score = jax.jit(
+            partial(_score_program, cfg=self.cfg, model=self.family)
+        )
+        self.score_shapes: List[Tuple[int, int]] = (
+            derive_score_shapes(
+                config.length_buckets, config.batch_buckets,
+                self.cfg.max_position_embeddings,
+            )
+            if config.scoring else []
+        )
         self._rng = jax.random.key(config.seed)
         self.state = self._init_state()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
@@ -1500,6 +1516,10 @@ class PagedEngine:
                 throwaway = self._init_state(wa)
                 with self.mesh:
                     self._grow(throwaway, wb)
+        # Scoring-tenant domain (empty unless EngineConfig.scoring): one
+        # program per (batch bucket, length bucket) shape, so the first
+        # bulk job a quantum dispatches pays zero live XLA compiles.
+        self._warm_score()
         if self.prefix_cache is not None and not self.fused:
             # Shared-prefix program domain: one export/load program per
             # prompt bucket wide enough to hold a block, one partial
@@ -1557,6 +1577,31 @@ class PagedEngine:
         self.pop_dispatch_stats()
         self.megastep_k = self._megastep_initial
         return time.monotonic() - t0
+
+    def _warm_score(self) -> int:
+        """Compile the score program over its (batch bucket x length
+        bucket) domain; a no-op (empty domain) when scoring is off."""
+        for nb, bucket in self.score_shapes:
+            ids = np.full((nb, bucket), self.tokenizer.pad_id, np.int32)
+            mask = np.ones((nb, bucket), bool)
+            with self.mesh:
+                self._score(self.params, jnp.asarray(ids),
+                            jnp.asarray(mask))
+        return len(self.score_shapes)
+
+    @property
+    def score_batch_cap(self) -> int:
+        """Texts per single-dispatch score quantum (the largest batch
+        bucket) — the scoring tenant's preemption granularity."""
+        return max(self.config.batch_buckets)
+
+    def score(self, texts: Sequence[str]) -> List[dict]:
+        """Log-likelihood scoring through the warmed `_score` program
+        (engine/scoring.py): per text logprob/tokens/ppl + a `truncated`
+        flag. The background scoring tenant's quantum calls this with at
+        most `score_batch_cap` texts — exactly one device dispatch, so
+        interactive work preempts at quantum boundaries."""
+        return score_texts(self, texts)
 
     @property
     def has_work(self) -> bool:

@@ -62,6 +62,31 @@ log = logging.getLogger(__name__)
 CHUNK_SIZE = 1024 * 1024  # reference streams 1 MB chunks (lms_server.py:1467)
 
 
+def collect_submission_texts(state: "LMSState",
+                             student: Optional[str] = None) -> list:
+    """The bulk-grading corpus: every submitted assignment's extracted
+    text (PDF text rides the replicated PostAssignment command), one
+    entry per submission, optionally filtered to one student. The LMS
+    admin plane (POST /admin/score) fans this to the tutoring fleet's
+    background scoring tenant — log-likelihood per submission is the
+    instructor's fluency/fit signal, computed at batch-128-class
+    throughput in the chip's idle lanes instead of one forward per
+    student on the interactive path."""
+    texts = []
+    for who, assignments in state.data["assignments"].items():
+        if student is not None and who != student:
+            continue
+        for assignment in assignments:
+            text = (assignment.get("text") or "").strip()
+            if not text:
+                # A scanned/empty PDF still grades as SOMETHING visible,
+                # not a silently skipped row.
+                text = assignment.get("filename") or ""
+            if text:
+                texts.append(text)
+    return texts
+
+
 class LMSServicer(rpc.LMSServicer):
     def __init__(
         self,

@@ -97,10 +97,8 @@ def affinity_key(query: str) -> str:
     return " ".join(query.split()).lower()[:64]
 
 
-async def _http_get_json(address: str, path: str,
-                         timeout_s: float = 2.0) -> Dict[str, Any]:
-    """Minimal async HTTP GET against a node-local healthz endpoint
-    (utils/healthz.py speaks exactly this much HTTP)."""
+async def _http_get_raw(address: str, path: str,
+                        timeout_s: float = 2.0) -> bytes:
     host, port = address.rsplit(":", 1)
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(host, int(port)), timeout_s
@@ -111,6 +109,80 @@ async def _http_get_json(address: str, path: str,
             "Connection: close\r\n\r\n".encode()
         )
         await writer.drain()
+        return await asyncio.wait_for(reader.read(-1), timeout_s)
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+
+async def _http_get_json(address: str, path: str,
+                         timeout_s: float = 2.0) -> Dict[str, Any]:
+    """Minimal async HTTP GET against a node-local healthz endpoint
+    (utils/healthz.py speaks exactly this much HTTP). Lenient: the body
+    is parsed regardless of status (the health poller treats any parse
+    failure as one failed poll)."""
+    raw = await _http_get_raw(address, path, timeout_s)
+    _head, _sep, body = raw.partition(b"\r\n\r\n")
+    return json.loads(body.decode())
+
+
+async def _http_get_admin(address: str, path: str,
+                          timeout_s: float = 2.0) -> Dict[str, Any]:
+    """Status-aware GET for admin reads proxied to callers: a node-side
+    404 must surface as KeyError, not as a 200 body missing its fields
+    (see `_parse_admin_response`)."""
+    raw = await _http_get_raw(address, path, timeout_s)
+    return _parse_admin_response(raw, "GET", path)
+
+
+def _parse_admin_response(raw: bytes, method: str,
+                          path: str) -> Dict[str, Any]:
+    """Status-aware parse of an admin-plane HTTP response: 404 raises
+    KeyError (the LMS proxy maps it back to its own 404 — an unknown or
+    retention-trimmed job must not poll as an eternal 200), other
+    non-2xx raise RuntimeError carrying the status AND whatever detail
+    the body held (raw text when it isn't JSON — a truncated error body
+    must not bury the status under a JSONDecodeError)."""
+    head, _sep, body = raw.partition(b"\r\n\r\n")
+    status_line = head.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+    parts = status_line.split()
+    status = parts[1] if len(parts) >= 2 else "?"
+    try:
+        doc = json.loads(body.decode())
+    except (ValueError, UnicodeDecodeError):
+        doc = None
+    if status.startswith("2") and isinstance(doc, dict):
+        return doc
+    detail = (doc.get("error", doc) if isinstance(doc, dict)
+              else body.decode("latin-1", "replace")[:200])
+    if status == "404":
+        raise KeyError(f"{method} {path} -> 404: {detail}")
+    raise RuntimeError(f"{method} {path} -> {status}: {detail}")
+
+
+async def _http_post_json(address: str, path: str, payload: Dict[str, Any],
+                          timeout_s: float = 10.0) -> Dict[str, Any]:
+    """POST sibling of `_http_get_json` (the tutoring admin plane —
+    drain, bulk score jobs — rides the same node-local HTTP endpoint).
+    Non-2xx responses raise (see `_parse_admin_response`)."""
+    host, port = address.rsplit(":", 1)
+    body = json.dumps(payload).encode()
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, int(port)), timeout_s
+    )
+    try:
+        writer.write(
+            (
+                f"POST {path} HTTP/1.1\r\nHost: {host}\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode() + body
+        )
+        await writer.drain()
         raw = await asyncio.wait_for(reader.read(-1), timeout_s)
     finally:
         writer.close()
@@ -118,8 +190,7 @@ async def _http_get_json(address: str, path: str,
             await writer.wait_closed()
         except (ConnectionError, OSError):
             pass
-    _head, _sep, body = raw.partition(b"\r\n\r\n")
-    return json.loads(body.decode())
+    return _parse_admin_response(raw, "POST", path)
 
 
 class TutoringNode:
@@ -260,6 +331,9 @@ class TutoringPool:
         # node index -> last observed breaker state code (see
         # _on_breaker_change for why this is tracked, not read live).
         self._breaker_codes: Dict[int, float] = {}  # guarded-by: event-loop
+        # Background score jobs routed through this pool: job id -> the
+        # fleet node holding it (GET /admin/score/<id> proxies there).
+        self._score_jobs: Dict[str, TutoringNode] = {}  # guarded-by: event-loop
         self._poller_task: Optional[asyncio.Task] = None
         # node index -> in-flight health-poll task (retained so the
         # cadence loop can skip hung probes and close() can cancel them).
@@ -451,6 +525,88 @@ class TutoringPool:
                 for n in self.rendezvous_order(key)
             ],
         }
+
+    # -------------------------------------------------- background jobs
+
+    def plan_background(self) -> List[TutoringNode]:
+        """Placement order for BACKGROUND work (bulk score jobs): off the
+        hot affinity nodes first. Interactive routing chases cache
+        affinity; background jobs have no prefix blocks to reuse and
+        must not land on the node a course's students are hammering —
+        order by observed queue depth, then by how much interactive
+        traffic the ring has routed there, so bulk work soaks the
+        COLDEST lanes and interactive p95 never pays for it."""
+        nodes = [n for n in self._nodes if n.routable()]
+        return sorted(
+            nodes,
+            key=lambda n: (self.queue_depth_of(n), n.routes, n.index),
+        )
+
+    async def submit_score_job(
+        self, texts: Sequence[str], *, purpose: str = "grading",
+        job_id: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Fan one bulk score job to the fleet's coldest scoring-capable
+        node (POST /admin/score on its admin plane). Returns
+        {job_id, node, health, ...job summary}; raises
+        TutoringUnavailable when no routable node accepts (no health
+        addresses configured, tenant disabled everywhere, or every
+        attempt failed)."""
+        errors: List[str] = []
+        candidates = [
+            n for n in self.plan_background() if n.health_address
+        ]
+        if not candidates:
+            raise TutoringUnavailable(
+                "no scoring-capable tutoring node: background jobs need "
+                "health_addresses (the admin plane they are submitted "
+                "over)", kind="none",
+            )
+        payload: Dict[str, Any] = {
+            "texts": list(texts), "purpose": purpose,
+        }
+        if job_id:
+            payload["job_id"] = job_id
+        for node in candidates:
+            assert node.health_address is not None
+            try:
+                doc = await _http_post_json(
+                    node.health_address, "/admin/score", payload
+                )
+            except Exception as e:  # noqa: BLE001 — try the next node
+                errors.append(f"{node.address}: {e}")
+                continue
+            jid = str(doc.get("job_id", ""))
+            if not jid:
+                errors.append(f"{node.address}: no job_id in {doc}")
+                continue
+            self._score_jobs[jid] = node
+            log.info("score job %s (%d texts, %s) routed to %s",
+                     jid, len(payload["texts"]), purpose, node.address)
+            return {
+                "job_id": jid,
+                "node": node.address,
+                "health": node.health_address,
+                "texts": doc.get("texts", len(payload["texts"])),
+                "status": doc.get("status", "queued"),
+            }
+        raise TutoringUnavailable(
+            f"every scoring submit failed: {errors[:3]}", kind="rpc"
+        )
+
+    async def score_job_status(self, job_id: str) -> Dict[str, Any]:
+        """Proxy GET /admin/score/<job_id> from the node the job was
+        routed to; KeyError for unknown ids — including a node-side 404
+        (retention-trimmed job, or a restarted node that lost its
+        in-memory jobs) — so the LMS plane answers 404 instead of a
+        status-less 200 a poller would spin on forever."""
+        node = self._score_jobs.get(job_id)
+        if node is None or node.health_address is None:
+            raise KeyError(job_id)
+        doc = await _http_get_admin(
+            node.health_address, f"/admin/score/{job_id}", timeout_s=10.0
+        )
+        return {"node": node.address, **doc}
 
     def _can_hedge(self, deadline: Optional[Deadline]) -> bool:
         if self.hedge_after_s <= 0:

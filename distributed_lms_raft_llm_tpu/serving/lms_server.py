@@ -32,8 +32,12 @@ from typing import Dict
 import grpc
 
 from ..lms.node import LMSNode
-from ..lms.service import FileTransferServicer, LMSServicer
-from ..lms.tutoring_pool import TutoringPool
+from ..lms.service import (
+    FileTransferServicer,
+    LMSServicer,
+    collect_submission_texts,
+)
+from ..lms.tutoring_pool import TutoringPool, TutoringUnavailable
 from ..proto import rpc
 from ..raft import RaftConfig
 from ..raft.grpc_transport import RaftServicer
@@ -167,6 +171,39 @@ def make_admin(lms_node: LMSNode, faults: FaultInjector,
                     "op must be 'add', 'remove', 'eject', or 'join'"
                 )
             return {"ok": True, "fleet": pool.snapshot()}
+        if path == "/admin/score":
+            # Bulk scoring through the fleet's BACKGROUND route
+            # (lms/tutoring_pool.plan_background — off the hot affinity
+            # nodes first): {"purpose": "grading", "student"?} fans the
+            # submitted-assignment corpus (lms/service.
+            # collect_submission_texts) to the coldest scoring-capable
+            # tutoring node; {"texts": [...]} scores an explicit corpus
+            # (relevance evals, gate-threshold calibration). Poll
+            # GET /admin/score/<job_id> for progress + results.
+            if pool is None:
+                raise ValueError("no tutoring pool on this node")
+            if "texts" in body:
+                texts = [str(t) for t in body["texts"]]
+            else:
+                texts = collect_submission_texts(
+                    lms_node.state,
+                    student=(str(body["student"])
+                             if body.get("student") else None),
+                )
+            if not texts:
+                raise ValueError(
+                    "no texts to score (no submissions yet, or an "
+                    "unknown student filter)"
+                )
+            try:
+                doc = await pool.submit_score_job(
+                    texts, purpose=str(body.get("purpose", "grading")),
+                    job_id=(str(body["job_id"]) if body.get("job_id")
+                            else None),
+                )
+            except TutoringUnavailable as e:
+                raise ValueError(f"scoring unavailable: {e}") from e
+            return {"ok": True, "submitted_texts": len(texts), **doc}
         if path == "/admin/transfer":
             target = body.get("target")
             chosen = await lms_node.node.transfer_leadership(
@@ -213,6 +250,14 @@ def make_admin(lms_node: LMSNode, faults: FaultInjector,
             return trace_admin_get(path)
         if path == "/admin/timeline":
             return timeline_admin_get(path, timeline)
+        if path.startswith("/admin/score/"):
+            # GET /admin/score/<job_id> — proxy the job's status (+
+            # results once done) from the tutoring node the background
+            # route placed it on.
+            if pool is None:
+                raise KeyError(path)
+            return {"ok": True,
+                    **await pool.score_job_status(path.rsplit("/", 1)[1])}
         if path.startswith("/admin/tutoring"):
             # GET /admin/tutoring — the routing tier's per-node map
             # (state, breaker, queue depth, routes/served counts).

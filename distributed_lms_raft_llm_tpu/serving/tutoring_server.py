@@ -32,8 +32,10 @@ from ..engine import (
     PagedEngine,
     PagedQueue,
     SamplingParams,
+    ScoringManager,
     TutoringEngine,
 )
+from ..engine.scoring import score_admin_get
 from ..proto import lms_pb2, rpc
 from ..utils import auth
 from ..utils.guards import make_serving_watchdog
@@ -165,32 +167,50 @@ async def _report_metrics(metrics: Metrics, period_s: float) -> None:
         log.info("metrics %s", json.dumps(metrics.snapshot()))
 
 
-def make_tutoring_admin(service: TutoringService):
+def make_tutoring_admin(service: TutoringService, scorer=None):
     """POST handler for the tutoring node's admin plane. Module-level
     (like lms_server.make_admin) so the in-process semester-sim fleet
     serves the EXACT operator surface the production entrypoint serves.
 
     POST /admin/drain {"drain": true|false} — stop/resume admission.
     Draining finishes in-flight work; the fleet router ejects the node
-    while it drains and re-admits it (warm-up weighted) when it ends."""
+    while it drains and re-admits it (warm-up weighted) when it ends.
+
+    POST /admin/score {"texts": [...], "purpose": "grading"|...,
+    "job_id"?} — queue one bulk job on the background scoring tenant
+    (engine/scoring.py; idempotent on job_id). Quanta run only while the
+    interactive queue is empty; progress and results are read back via
+    GET /admin/score[/<job-id>]. 404 when the tenant is disabled."""
 
     async def admin(path: str, body: dict) -> dict:
-        if path != "/admin/drain":
-            raise KeyError(path)
-        service.set_draining(bool(body.get("drain", True)))
-        return {"ok": True, "draining": service.draining,
-                "node_id": service.node_id}
+        if path == "/admin/drain":
+            service.set_draining(bool(body.get("drain", True)))
+            return {"ok": True, "draining": service.draining,
+                    "node_id": service.node_id}
+        if path == "/admin/score":
+            if scorer is None:
+                raise KeyError(path)  # scoring tenant disabled: 404
+            texts = body.get("texts")
+            if not isinstance(texts, list):
+                raise ValueError("score job needs 'texts': [str, ...]")
+            job = scorer.submit(
+                texts, purpose=str(body.get("purpose", "adhoc")),
+                job_id=(str(body["job_id"]) if body.get("job_id")
+                        else None),
+            )
+            return {"ok": True, "node_id": service.node_id, **job}
+        raise KeyError(path)
 
     return admin
 
 
 def make_tutoring_health(service: TutoringService, queue,
-                         engine_name: str, max_queue: int):
+                         engine_name: str, max_queue: int, scorer=None):
     """/healthz provider: admission pressure + fleet lifecycle state
     (the router's health poller reads `draining`/`queued`/`node_id`)."""
 
     def health() -> dict:
-        return {
+        doc = {
             "ok": True,
             "engine": engine_name,
             "node_id": service.node_id,
@@ -204,6 +224,12 @@ def make_tutoring_health(service: TutoringService, queue,
             # finishes what it holds; the router ejects it meanwhile.
             "draining": service.draining,
         }
+        if scorer is not None:
+            # Background-tenant surface: backlog/quanta/completed at a
+            # glance (the LMS router's background route reads `queued`
+            # above for placement; scoring detail is informational).
+            doc["scoring"] = scorer.stats()
+        return doc
 
     return health
 
@@ -223,6 +249,10 @@ async def serve_async(
     telemetry_interval_s: float = 1.0,
     telemetry_ring: int = 600,
     node_id: Optional[str] = None,
+    scoring: bool = False,
+    scoring_max_job_texts: int = 4096,
+    scoring_jobs_retained: int = 32,
+    scoring_chip_ceiling: float = 61500.0,
 ) -> grpc.aio.Server:
     """Start (and return) the aio server; caller awaits termination.
 
@@ -231,14 +261,27 @@ async def serve_async(
     mid-decode); the matching queue front-end is picked automatically.
     `max_queue` bounds waiting requests (0 = unbounded): beyond it new
     RPCs are refused with RESOURCE_EXHAUSTED instead of queueing forever.
+    `scoring` attaches the background bulk-scoring tenant
+    (engine/scoring.ScoringManager + POST/GET /admin/score): quanta run
+    only while the interactive queue is empty and yield at
+    single-dispatch boundaries.
     """
     metrics = metrics or Metrics()
+    scorer = None
+    if scoring:
+        scorer = ScoringManager(
+            engine, metrics=metrics,
+            max_job_texts=scoring_max_job_texts,
+            jobs_retained=scoring_jobs_retained,
+            chip_ceiling_tokens_per_s=scoring_chip_ceiling,
+        )
     if isinstance(engine, PagedEngine):
-        queue = PagedQueue(engine, metrics=metrics, max_queue=max_queue)
+        queue = PagedQueue(engine, metrics=metrics, max_queue=max_queue,
+                           scorer=scorer)
     else:
         queue = BatchingQueue(engine, max_batch=max_batch,
                               max_wait_ms=max_wait_ms, metrics=metrics,
-                              max_queue=max_queue)
+                              max_queue=max_queue, scorer=scorer)
     await queue.start()
     server = grpc.aio.server(
         options=[
@@ -297,17 +340,22 @@ async def serve_async(
             # (engine spans live HERE; trace_report merges them with the
             # LMS nodes' fragments into one waterfall).
             # GET /admin/timeline: the telemetry ring.
+            # GET /admin/score[/<job-id>]: the scoring tenant's job list
+            # / one job's progress+results (404 when disabled).
             if path == "/admin/timeline":
                 return timeline_admin_get(
                     path, sampler.timeline if sampler is not None else None
                 )
+            if path.startswith("/admin/score"):
+                return score_admin_get(path, scorer)
             return trace_admin_get(path)
 
         server._health = HealthServer(
             metrics,
             health=make_tutoring_health(service, queue,
-                                        type(engine).__name__, max_queue),
-            admin=make_tutoring_admin(service),
+                                        type(engine).__name__, max_queue,
+                                        scorer=scorer),
+            admin=make_tutoring_admin(service, scorer=scorer),
             admin_get=admin_get,
             port=metrics_port,
         )
@@ -420,6 +468,19 @@ def main(argv=None) -> None:
                         "n-gram continuation; ngram = per-slot "
                         "modal-continuation table (paged only, higher "
                         "acceptance at temperature>0)")
+    parser.add_argument("--scoring", action="store_true",
+                        help="background bulk-scoring tenant "
+                        "(engine/scoring.py): warmup-cover the score "
+                        "program domain and co-schedule preemptible "
+                        "score quanta into idle lanes — POST/GET "
+                        "/admin/score on the metrics plane; quanta run "
+                        "only while the interactive queue is empty "
+                        "([scoring] in the TOML)")
+    parser.add_argument("--scoring-max-job-texts", type=int, default=4096,
+                        help="admission cap per bulk score job (texts)")
+    parser.add_argument("--scoring-jobs-retained", type=int, default=32,
+                        help="finished score jobs kept for "
+                        "GET /admin/score")
     parser.add_argument("--node-id", default=None,
                         help="fleet member identity: rides every "
                         "answer's x-served-by response trailer and "
@@ -485,9 +546,13 @@ def main(argv=None) -> None:
             "kv_quant": t.kv_quant, "paged": t.paged,
             "approx_topk": s.approx_top_k,
             "spec_tokens": t.spec_tokens,
+            "scoring": cfg.scoring.enabled,
+            "scoring_max_job_texts": cfg.scoring.max_job_texts,
+            "scoring_jobs_retained": cfg.scoring.jobs_retained,
             "telemetry_interval": cfg.telemetry.sample_interval_s,
             "telemetry_ring": cfg.telemetry.ring_points,
         }, argv=argv)
+        args.scoring_chip_ceiling = cfg.telemetry.chip_ceiling_tokens_per_s
         if not args.no_telemetry:
             args.telemetry = cfg.telemetry.enabled
         args.sampling_overrides = dict(
@@ -501,6 +566,7 @@ def main(argv=None) -> None:
         configure_from(cfg.tracing)
     else:
         args.sampling_overrides = {}
+        args.scoring_chip_ceiling = 61500.0
     if args.jax_platform == "cpu":
         import jax
 
@@ -541,6 +607,10 @@ def main(argv=None) -> None:
         kv_quant=args.kv_quant,
         spec_tokens=args.spec_tokens,
         draft_source=args.draft_source,
+        # Scoring-tenant warmup coverage: with --scoring, warmup compiles
+        # the score program's (batch bucket x length bucket) domain so
+        # the first bulk job pays zero live XLA compiles.
+        scoring=args.scoring,
     )
     if args.paged:
         # --max-batch bounds concurrency in both modes: it is the decode
@@ -585,6 +655,10 @@ def main(argv=None) -> None:
             telemetry_interval_s=args.telemetry_interval,
             telemetry_ring=args.telemetry_ring,
             node_id=args.node_id or f"tut-{args.port}",
+            scoring=args.scoring,
+            scoring_max_job_texts=args.scoring_max_job_texts,
+            scoring_jobs_retained=args.scoring_jobs_retained,
+            scoring_chip_ceiling=args.scoring_chip_ceiling,
         )
         await server.wait_for_termination()
 

@@ -84,6 +84,10 @@ class EchoEngine:
     TutoringEngine exercises, not a sim-only shortcut.
     """
 
+    # Scoring-tenant quantum size (texts per single dispatch), mirroring
+    # the real engines' `score_batch_cap` property.
+    score_batch_cap = 4
+
     def __init__(self, delay_s: float = 0.002):
         self.delay_s = delay_s
         self._prog_times: List[Tuple[str, float, float]] = []
@@ -97,6 +101,21 @@ class EchoEngine:
         return [f"Echo tutor: {p.splitlines()[-2][:96]}"
                 if len(p.splitlines()) >= 2 else f"Echo tutor: {p[:96]}"
                 for p in prompts]
+
+    def score(self, texts: List[str]) -> List[Dict]:
+        """Deterministic stand-in for the real engines' bulk-scoring
+        quantum (engine/scoring.score_texts contract: logprob/tokens/
+        ppl/truncated per text) — the sim's bulk-grading night runs the
+        REAL admin plane, job manager, and co-scheduler against it."""
+        t0, t0_unix = time.monotonic(), time.time()
+        time.sleep(self.delay_s)
+        self._prog_times.append(("score", t0_unix, time.monotonic() - t0))
+        out = []
+        for text in texts:
+            n = max(1, len(text.split()))
+            out.append({"logprob": -1.5 * n, "tokens": n,
+                        "ppl": 4.4817, "truncated": False})
+        return out
 
     def pop_program_times(self) -> List[Tuple[str, float, float]]:
         out, self._prog_times = self._prog_times, []
@@ -462,10 +481,11 @@ class SimCluster:
         (make_tutoring_health/make_tutoring_admin). Node 0 runs the
         configured engine; extra members (and autoscale spawns) run the
         echo stand-in so a 3-node fleet costs no extra XLA compiles."""
-        from ..engine import BatchingQueue, PagedQueue
+        from ..engine import BatchingQueue, PagedQueue, ScoringManager
 
         queue = None
         metrics = Metrics()
+        scorer = None
         if (self.cfg.tutoring_engine in ("tiny", "tiny-paged")
                 and idx == 0 and not force_echo):
             import jax
@@ -482,6 +502,10 @@ class SimCluster:
                 sampling=SamplingParams(max_new_tokens=8),
                 length_buckets=(32,), batch_buckets=(1, 2, 4),
                 dtype=jax.numpy.float32,
+                # Bulk-grading night runs against the REAL score path:
+                # warmup covers the score domain so the mid-run job
+                # compiles nothing live.
+                scoring=self.cfg.bulk_scoring,
             )
             if self.cfg.tutoring_engine == "tiny-paged":
                 # The real serving configuration scaled down: paged
@@ -510,7 +534,12 @@ class SimCluster:
                     # diurnal churn (decode_stalled_tokens stays 0).
                     prefill_chunk_tokens=8,
                 )
-                queue = PagedQueue(engine, metrics=metrics, max_queue=64)
+                if self.cfg.bulk_scoring:
+                    scorer = ScoringManager(engine, metrics=metrics,
+                                            max_job_texts=1024,
+                                            jobs_retained=8)
+                queue = PagedQueue(engine, metrics=metrics, max_queue=64,
+                                   scorer=scorer)
             else:
                 engine = TutoringEngine(config)
             # Compile now, while this loop runs nothing else: tutoring
@@ -522,9 +551,16 @@ class SimCluster:
                 engine.warmup(batch=4)
         else:
             engine = EchoEngine()
+        if self.cfg.bulk_scoring and scorer is None:
+            # Every fleet member runs the background scoring tenant: the
+            # bulk-grading night lands on whichever node the LMS router's
+            # background route picks (the coldest one).
+            scorer = ScoringManager(engine, metrics=metrics,
+                                    max_job_texts=1024, jobs_retained=8)
         if queue is None:
             queue = BatchingQueue(engine, max_batch=4, max_wait_ms=5.0,
-                                  metrics=metrics, max_queue=64)
+                                  metrics=metrics, max_queue=64,
+                                  scorer=scorer)
         await queue.start()
         server = grpc.aio.server()
         service = TutoringService(queue, metrics, node_id=f"tut{idx}")
@@ -536,11 +572,23 @@ class SimCluster:
         else:
             port = server.add_insecure_port("127.0.0.1:0")
         await server.start()
+
+        async def tutoring_admin_get(path: str,
+                                     _scorer=scorer) -> Dict:
+            # GET /admin/score[/<job-id>]: the scoring tenant's job list
+            # / one job's progress+results — the same read surface the
+            # production entrypoint serves.
+            from ..engine.scoring import score_admin_get
+
+            return score_admin_get(path, _scorer)
+
         health = HealthServer(
             metrics,
             health=make_tutoring_health(service, queue,
-                                        type(engine).__name__, 64),
-            admin=make_tutoring_admin(service),
+                                        type(engine).__name__, 64,
+                                        scorer=scorer),
+            admin=make_tutoring_admin(service, scorer=scorer),
+            admin_get=tutoring_admin_get,
             port=(self.tutoring_health_port(idx) if want is not None
                   else 0),
         )

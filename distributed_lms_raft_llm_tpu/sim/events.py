@@ -44,6 +44,18 @@ MEMBERSHIP_REMOVE = "membership_remove"
 TUTORING_BLACKOUT = "tutoring_blackout"
 TUTORING_DRAIN = "tutoring_drain_rejoin"
 TUTORING_AUTOSCALE = "tutoring_autoscale"
+# Bulk grading night ([sim] bulk_scoring): an instructor-scale score job
+# fans every submitted assignment to the tutoring fleet's background
+# scoring tenant via the LMS admin plane, mid-run, while student traffic
+# keeps flowing. The job must COMPLETE; interactive p95 must not move.
+BULK_GRADING = "bulk_grading_night"
+
+# Events that are OPERATIONS, not faults: the continuous SLO engine
+# classifies burn alerts against fault windows only, so a latency alert
+# raised while (e.g.) the bulk-grading job runs is a FALSE ALARM and
+# fails the verdict — exactly the "interactive p95 unchanged while the
+# job runs" claim, enforced by the existing alarm discipline.
+NON_FAULT_KINDS = frozenset({BULK_GRADING})
 
 # The ops bot's fixed ask: the fleet drills resolve ITS affinity node
 # via GET /admin/tutoring/route and then fault/drain exactly that node,
@@ -108,6 +120,15 @@ def plan_events(cfg: SimConfig) -> List[SimEvent]:
         SimEvent(at_s=_jitter(rng, 0.90, 0.02) * T, kind=MEMBERSHIP_REMOVE,
                  params={}),
     ]
+    if cfg.bulk_scoring:
+        # The "night" lands in the post-chaos lull before the rolling
+        # restart: the job must share the chip with live student traffic
+        # (that is the claim), but a restart mid-poll would reset the
+        # counters the completion check reads.
+        events.append(SimEvent(
+            at_s=_jitter(rng, 0.26, 0.02) * T, kind=BULK_GRADING,
+            params={"timeout_s": round(max(6.0, 0.4 * T), 3)},
+        ))
     if cfg.tutoring_nodes > 1:
         # Fleet drills land AFTER the rolling restart (0.38T): the node
         # that routes (and counts hedges/spills) must not be restarted
@@ -179,13 +200,17 @@ class OperationsScheduler:
 
     def event_windows(self) -> List[tuple]:
         """(start_s, end_s) wall intervals (offsets from workload start)
-        each event actually occupied — the continuous SLO engine
+        each FAULT event actually occupied — the continuous SLO engine
         classifies burn-rate alerts against these: an alert inside a
-        fault phase is the system working, one outside is a false
-        alarm."""
+        fault phase is the system working, one outside is a false alarm.
+        Non-fault operations (NON_FAULT_KINDS — the bulk-grading night)
+        are excluded on purpose: background scoring promises NOT to move
+        interactive latency, so an alert during it must fail the run,
+        not be excused by it."""
         with self._lock:
             return [(o["t0_s"], o["t1_s"]) for o in self.outcomes
-                    if "t0_s" in o and "t1_s" in o]
+                    if "t0_s" in o and "t1_s" in o
+                    and o["kind"] not in NON_FAULT_KINDS]
 
     # ------------------------------------------------------------ internals
 
@@ -207,6 +232,7 @@ class OperationsScheduler:
                     TUTORING_BLACKOUT: self._tutoring_blackout,
                     TUTORING_DRAIN: self._tutoring_drain,
                     TUTORING_AUTOSCALE: self._tutoring_autoscale,
+                    BULK_GRADING: self._bulk_grading,
                 }[event.kind]
                 outcome["detail"] = handler(event)
                 outcome["ok"] = True
@@ -413,6 +439,59 @@ class OperationsScheduler:
         )
         self.cluster.stop_node(nid)
         return f"removed node {nid} and stopped it"
+
+    def _bulk_grading(self, event: SimEvent) -> str:
+        """Bulk grading night: fan every submitted assignment to the
+        tutoring fleet's background scoring tenant via the LMS leader's
+        admin plane (POST /admin/score routes off the hot affinity nodes
+        — lms/tutoring_pool.plan_background), then poll the placed
+        node's GET /admin/score/<id> until the job completes. Student
+        traffic keeps flowing the whole time; the continuous SLO engine
+        treats this window as NON-fault, so a scoring-induced latency
+        alert fails the run — "interactive p95 unchanged while the job
+        runs" is enforced, not assumed."""
+        import json as _json
+        import urllib.request
+
+        p = event.params
+        resp = self._post_leader("/admin/score", {"purpose": "grading"})
+        job_id = resp["job_id"]
+        health = resp["health"]
+        submitted = int(resp.get("submitted_texts", 0))
+        deadline = time.monotonic() + p["timeout_s"]
+        doc: Dict = {}
+        while time.monotonic() < deadline:
+            # Poll the tutoring node directly (leadership may move while
+            # the job runs; the placing node's admin plane is sticky).
+            req = urllib.request.Request(
+                f"http://{health}/admin/score/{job_id}", method="GET"
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10.0) as r:
+                    doc = _json.loads(r.read().decode())
+            except Exception as e:  # transient poll failure: keep trying
+                log.info("bulk-grading poll failed: %s", e)
+                time.sleep(0.2)
+                continue
+            if doc.get("status") in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        if doc.get("status") != "done":
+            raise RuntimeError(
+                f"bulk grading job {job_id} did not complete in "
+                f"{p['timeout_s']}s: {doc.get('status')!r} "
+                f"({doc.get('error')})"
+            )
+        results = doc.get("results") or []
+        if submitted and len(results) != submitted:
+            raise RuntimeError(
+                f"bulk grading job {job_id} returned {len(results)} "
+                f"results for {submitted} submissions"
+            )
+        return (f"graded {len(results)} submissions in {doc.get('quanta')}"
+                f" preemptible quanta on {resp.get('node')} "
+                f"({doc.get('scored_tokens')} tokens scored in the idle "
+                "lanes, interactive traffic untouched)")
 
     # ------------------------------------------------------ fleet drills
 

@@ -205,6 +205,7 @@ class SemesterSim:
             node_metrics, node_health = self.cluster.scrape_all()
             traces = get_tracer().records()
             fleet = self._fleet_summary(node_metrics, node_health)
+            scoring = self._scoring_summary()
             report = evaluate_slos(
                 self.cfg, node_metrics, node_health,
                 self.metrics.snapshot(), self.ledger.report(),
@@ -215,10 +216,12 @@ class SemesterSim:
                 continuous=(telemetry.engine.report()
                             if telemetry is not None else None),
                 fleet=fleet,
+                scoring=scoring,
             )
             return self._record(ops, plan, scheduler, report, node_metrics,
                                 traces, time.monotonic() - t_start,
-                                telemetry=telemetry, fleet=fleet)
+                                telemetry=telemetry, fleet=fleet,
+                                scoring=scoring)
         finally:
             for c in self._clients.values():
                 c.close()
@@ -604,9 +607,37 @@ class SemesterSim:
             "nodes": nodes,
         }
 
+    def _scoring_summary(self) -> Optional[Dict]:
+        """Background scoring-tenant evidence from the tutoring fleet's
+        merged counters: the bulk-grading night's completion claim
+        (`bulk_scoring_completed`) and the record's idle-lane-harvest
+        block. None when [sim] bulk_scoring is off."""
+        if not self.cfg.bulk_scoring:
+            return None
+        tut = self.cluster.tutoring_metrics_snapshot()
+        return {
+            # The verdict only DEMANDS a completed job when the event
+            # schedule actually ran the bulk-grading night.
+            "expected": bool(self.cfg.events),
+            "jobs_completed": snap_counter(
+                tut, metric.SCORING_JOBS_COMPLETED
+            ),
+            "jobs_failed": snap_counter(tut, metric.SCORING_JOBS_FAILED),
+            "quanta": snap_counter(tut, metric.SCORING_QUANTA),
+            "scored_tokens": snap_counter(
+                tut, metric.SCORING_SCORED_TOKENS
+            ),
+            "truncated_texts": snap_counter(
+                tut, metric.SCORE_TRUNCATED_TEXTS
+            ),
+            "preempt_wait_ms": snap_counter(
+                tut, metric.SCORE_PREEMPT_WAIT_MS
+            ),
+        }
+
     def _record(self, ops, plan, scheduler, report, node_metrics,
                 traces, wall_s: float, telemetry=None,
-                fleet=None) -> Dict:
+                fleet=None, scoring=None) -> Dict:
         snap = self.metrics.snapshot()
         counters = snap.get("counters", {})
         ask = snap_hist(snap, metric.SIM_ASK_LATENCY)
@@ -650,6 +681,10 @@ class SemesterSim:
             # the acceptance evidence for the kill-one-of-N and
             # drain-and-rejoin drills.
             "tutoring_fleet": fleet,
+            # Idle-lane harvest evidence (None when [sim] bulk_scoring is
+            # off): the bulk-grading night's jobs/quanta/tokens plus the
+            # measured interactive preemption wait behind score quanta.
+            "scoring": scoring,
             "course_concentration": self.cfg.course_concentration,
             # Measured shared-prefix KV cache hit rate on the tutoring
             # node (None unless the engine runs the radix cache, i.e.

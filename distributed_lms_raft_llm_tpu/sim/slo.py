@@ -82,6 +82,9 @@ class SloReport:
     # Tutoring-fleet summary (router spill/hedge counters + per-node
     # end-state map); None for a one-node fleet.
     fleet: Optional[Dict[str, Any]] = None
+    # Background scoring-tenant summary (jobs/quanta/tokens from the
+    # tutoring fleet's counters); None when the tenant is disabled.
+    scoring: Optional[Dict[str, Any]] = None
 
     @property
     def ok(self) -> bool:
@@ -100,6 +103,7 @@ class SloReport:
             "prefix_cache_hit_rate": self.prefix_cache_hit_rate,
             "continuous": self.continuous,
             "fleet": self.fleet,
+            "scoring": self.scoring,
         }
 
 
@@ -352,6 +356,7 @@ def evaluate_slos(
     metrics: Optional[Metrics] = None,
     continuous: Optional[Dict[str, Any]] = None,
     fleet: Optional[Dict[str, Any]] = None,
+    scoring: Optional[Dict[str, Any]] = None,
 ) -> SloReport:
     """`node_metrics`/`node_health`: node id -> scraped JSON snapshots of
     every node alive at the end of the run; `sim_metrics`: the harness's
@@ -477,6 +482,22 @@ def evaluate_slos(
               else f"all {fleet.get('size', 0)} nodes routable",
               "no node left ejected/draining")
 
+    if scoring is not None and scoring.get("expected"):
+        # The bulk-grading night's completion claim: the background
+        # tenant finished its job(s) in the idle lanes (the "p95
+        # unchanged" half is enforced by no_false_alarms above — the
+        # grading window is NOT a fault window, so a scoring-induced
+        # burn alert fails the run).
+        done = int(scoring.get("jobs_completed", 0))
+        failed = int(scoring.get("jobs_failed", 0))
+        check(
+            "bulk_scoring_completed", done >= 1 and failed == 0,
+            f"{done} completed / {failed} failed "
+            f"({scoring.get('quanta', 0)} quanta, "
+            f"{scoring.get('scored_tokens', 0)} tokens scored)",
+            ">= 1 bulk job completed, 0 failed",
+        )
+
     hit_rate = snap_gauge(tutoring_metrics or {},
                           metric.PREFIX_CACHE_HIT_RATE, default=-1.0)
     return SloReport(
@@ -484,4 +505,5 @@ def evaluate_slos(
         prefix_cache_hit_rate=hit_rate if hit_rate >= 0 else None,
         continuous=continuous,
         fleet=fleet,
+        scoring=scoring,
     )

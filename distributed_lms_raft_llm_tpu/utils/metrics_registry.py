@@ -333,6 +333,57 @@ SERVING_QUEUE_DEPTH = gauge(
     "the capacity model and autoscaler watch",
 )
 
+# Background bulk-scoring tenant (engine/scoring.py + engine/batcher.py):
+# idle-lane harvest — preemptible score quanta co-scheduled behind
+# interactive traffic, driving the chip toward its saturation ceiling.
+
+SCORING_TOKENS_PER_S = gauge(
+    "scoring_tokens_per_s",
+    "recent background-scoring throughput: tokens scored per second over "
+    "the last few seconds of quanta — the scoring tenant's half of the "
+    "tenant-split utilization view (serving_tokens_per_s is the "
+    "interactive half)",
+)
+SCORING_UTILIZATION = gauge(
+    "scoring_utilization",
+    "scoring_tokens_per_s as a fraction of the measured chip saturation "
+    "ceiling (BENCH_NOTES: ~61.5k tok/s int8 at batch 128+) — how much "
+    "of the idle headroom the background tenant is actually harvesting",
+)
+SCORING_QUANTA = counter(
+    "scoring_quanta",
+    "single-dispatch scoring quanta executed (one batch-bucket forward "
+    "each — the preemption granularity interactive arrivals wait behind "
+    "at most one of)",
+)
+SCORING_SCORED_TOKENS = counter(
+    "scoring_scored_tokens",
+    "corpus tokens the background tenant has scored (bulk grading / "
+    "relevance / calibration texts; the cumulative companion of the "
+    "scoring_tokens_per_s gauge)",
+)
+SCORING_JOBS_COMPLETED = counter(
+    "scoring_jobs_completed",
+    "bulk score jobs run to completion by the background tenant",
+)
+SCORING_JOBS_FAILED = counter(
+    "scoring_jobs_failed",
+    "bulk score jobs that failed (the job fails; the serving loop and "
+    "other jobs keep going)",
+)
+SCORE_TRUNCATED_TEXTS = counter(
+    "score_truncated_texts",
+    "scored texts longer than the length-bucket limit whose PREFIX was "
+    "scored (each carries a per-item truncated flag so relevance evals "
+    "can't silently read a prefix score as a full-document score)",
+)
+SCORE_PREEMPT_WAIT_MS = counter(
+    "score_preempt_wait_ms",
+    "milliseconds interactive requests waited behind an in-flight "
+    "scoring quantum before admission resumed (bounded by one quantum "
+    "per arrival — the scoring tenant's preemption-latency account)",
+)
+
 # Per-program engine dispatch wall time (host-side: the time the serving
 # loop spends issuing each compiled program; device compute overlaps it
 # under pipelining). Names key the program-inventory entries — the
@@ -376,6 +427,11 @@ ENGINE_PROG_STAGE = histogram(
     "arming a slot's staged prompt; the prefill itself runs inside the "
     "megastep scan)",
 )
+ENGINE_PROG_SCORE = histogram(
+    "engine_prog_score",
+    "score program dispatch wall time (one background-scoring quantum: "
+    "a full-sequence batch-bucket forward — the preemption granularity)",
+)
 ENGINE_PROG_GENERATE = histogram(
     "engine_prog_generate",
     "bucketed-engine generate dispatch wall time (one grouped device "
@@ -393,6 +449,7 @@ ENGINE_PROGRAM_HISTOGRAMS: Dict[str, str] = {
     "megastep": ENGINE_PROG_MEGASTEP,
     "grow": ENGINE_PROG_GROW,
     "stage": ENGINE_PROG_STAGE,
+    "score": ENGINE_PROG_SCORE,
     "generate": ENGINE_PROG_GENERATE,
 }
 

@@ -64,6 +64,11 @@ _DASH_ROWS: Tuple[Tuple[str, str, str], ...] = (
     ("shed expired/s", "rate", "shed_expired"),
     ("tick stalls/s", "rate", "raft_tick_stalls"),
     ("serving tok/s", "gauge", "serving_tokens_per_s"),
+    # The tenant split: background bulk scoring's share of the chip next
+    # to interactive serving (utilization is vs the 61.5k ceiling).
+    ("scoring tok/s", "gauge", "scoring_tokens_per_s"),
+    ("scoring util", "gauge", "scoring_utilization"),
+    ("score quanta/s", "rate", "scoring_quanta"),
     ("queue depth", "gauge", "serving_queue_depth"),
     ("prefix hit rate", "gauge", "prefix_cache_hit_rate"),
     ("megastep K", "gauge", "megastep_k"),

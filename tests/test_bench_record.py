@@ -120,6 +120,39 @@ def test_bench_sweep_grid_smoke():
         assert r["host_dispatches_per_token"] > 0
 
 
+def test_bench_score_scenario_record_smoke():
+    """bench.py --score-scenario: the two-tenant record (interactive load
+    with the background scoring tenant off/on) must witness the
+    acceptance claims — quanta executed ONLY while the interactive
+    pending queue was empty (quanta_with_pending == 0), the bulk job
+    completed in the idle lanes, every preemption wait stayed under one
+    quantum, and the interactive p90 TTFT delta is bounded."""
+    from bench import bench_score_scenario
+
+    out = bench_score_scenario(
+        model="tiny", slots=2, chunk=2, interactive=6, arrival_s=0.02,
+        score_texts_n=10, score_text_tokens=12, max_new=8, prompt_len=8,
+        length_buckets=(8, 16), greedy=True,
+    )
+    assert out["metric"] == "paged_score_tenant_total_tokens_per_sec_per_chip"
+    assert out["unit"] == "tokens/sec/chip"
+    assert out["total_tokens_per_sec_per_chip_off"] > 0
+    assert out["total_tokens_per_sec_per_chip_on"] > 0
+    # The harvest: the ON phase really scored the bulk corpus...
+    assert out["scored_tokens"] > 0
+    assert out["scoring_jobs_completed"] == 1
+    assert out["scoring_quanta"] >= 2  # ceil(10 texts / batch cap 8)
+    # ...and ONLY in idle lanes: zero quanta admitted while interactive
+    # work waited, and any arrival that landed mid-quantum waited at
+    # most one quantum for its dispatch.
+    assert out["quanta_with_pending"] == 0
+    assert out["max_preempt_wait_ms"] <= out["max_quantum_wall_ms"] + 50
+    # Interactive p90 TTFT holds (pinned loosely for CPU CI noise: the
+    # real bound is the chip record's; a co-scheduler that blocked
+    # interactive work behind the whole job would blow far past this).
+    assert out["ttft_p90_ms_on"] <= out["ttft_p90_ms_off"] + 2000.0
+
+
 def test_bench_paged_carries_prefix_knob_and_hit_rate():
     from bench import bench_paged
 

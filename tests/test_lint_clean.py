@@ -374,6 +374,51 @@ def test_host_readback_in_staged_reap_fails_lint():
         "outside intended_transfer() must fail no-host-sync-in-dispatch"
 
 
+SCORING = "distributed_lms_raft_llm_tpu/engine/scoring.py"
+
+
+def test_host_sync_in_score_quantum_loop_fails_lint():
+    """PR-15 acceptance pin: engine/scoring.py is a dispatch module — a
+    bare `.item()` dropped into the quantum loop (a per-quantum device
+    round trip on the serving chip) must fail no-host-sync-in-dispatch,
+    same as it would in the decode path."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.host_sync import (
+        HostSyncInDispatchRule,
+    )
+
+    project = _project_with_patch(SCORING, (
+        'tokens = sum(int(r["tokens"]) for r in results)',
+        "tokens = device_total.item()",
+    ))
+    findings = HostSyncInDispatchRule().check(project.sources[SCORING])
+    assert findings, "a bare .item() in the scoring quantum loop must " \
+        "fail no-host-sync-in-dispatch"
+
+
+def test_uninventoried_score_jit_entry_fails_lint():
+    """PR-15 acceptance pin: the score program is inventoried like every
+    other jit entry — a second scoring program added without
+    regenerating the manifest must fail program-inventory."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.program_inventory import (
+        ProgramInventoryRule,
+    )
+
+    project = _project_with_patch(PAGED, (
+        "self._score = jax.jit(",
+        "self._rogue_score = jax.jit(\n"
+        "            partial(_score_program, cfg=self.cfg, "
+        "model=self.family)\n"
+        "        )\n"
+        "        self._score = jax.jit(",
+    ))
+    findings = [
+        f for f in ProgramInventoryRule().check_project(project)
+        if "uninventoried" in f.message
+    ]
+    assert findings, "a scoring jit entry missing from the manifest " \
+        "must fail program-inventory"
+
+
 def test_uninventoried_jit_entry_fails_lint():
     from distributed_lms_raft_llm_tpu.analysis.rules.program_inventory import (
         ProgramInventoryRule,

@@ -70,10 +70,16 @@ def test_manifest_covers_the_paged_program_set():
     attrs = {e.attr for e in inv.entries_for("PagedEngine")}
     assert attrs == {"_prefill", "_install", "_step", "_megastep", "_grow",
                      "_partial_prefill", "_load_block", "_export_block",
-                     "_stage", "_stage_block"}
+                     "_stage", "_stage_block", "_score"}
     assert all(
         e.coverage == "warmup" for e in inv.entries_for("PagedEngine")
     ), "the paged engine's whole program set is a warmup promise"
+    # The bulk-scoring program is a warmup promise on BOTH engines
+    # (domain empty when EngineConfig.scoring is off).
+    score = [e for e in inv.entries_for("TutoringEngine")
+             if e.attr == "_score"]
+    assert score and score[0].coverage == "warmup"
+    assert score[0].domain == "score-pairs"
 
 
 def test_static_domain_math_is_engine_math():

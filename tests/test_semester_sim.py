@@ -77,8 +77,27 @@ def test_sim_executed_every_event_kind(sim_run):
     for kind in ("rolling_restart", "quarantine", "membership_add",
                  "membership_remove", "chaos_campaign",
                  "tutoring_blackout", "tutoring_drain_rejoin",
-                 "tutoring_autoscale"):
+                 "tutoring_autoscale", "bulk_grading_night"):
         assert executed.get(kind, 0) >= 1, f"missing event kind {kind}"
+
+
+def test_sim_bulk_grading_harvested_idle_lanes(sim_run):
+    """PR-15 acceptance: the bulk-grading night's score job fanned to
+    the tutoring fleet's background tenant via the LMS admin plane and
+    COMPLETED in preemptible quanta while student traffic kept flowing —
+    with interactive p95 untouched (the grading window is a NON-fault
+    window, so a scoring-induced burn alert would have failed
+    `no_false_alarms` above)."""
+    record, _ = sim_run
+    scoring = record["scoring"]
+    assert scoring is not None
+    assert scoring["jobs_completed"] >= 1, scoring
+    assert scoring["jobs_failed"] == 0, scoring
+    assert scoring["quanta"] >= 1 and scoring["scored_tokens"] > 0
+    checks = record["slos"]["checks"]
+    assert checks["bulk_scoring_completed"]["ok"], (
+        checks["bulk_scoring_completed"]
+    )
 
 
 def test_sim_fleet_drills_spilled_hedged_and_restored_affinity(sim_run):
