@@ -52,6 +52,7 @@ __all__ = [
 # module cannot silently update one rule but not the other.
 EGRESS_ROOT_MODULES = (
     "distributed_lms_raft_llm_tpu/lms/tutoring_pool.py",
+    "distributed_lms_raft_llm_tpu/lms/group_router.py",
 )
 
 

@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Sequence, TypeVar
 
 import grpc
 
+from ..lms.group_router import USER_METADATA_KEY
 from ..proto import lms_pb2, rpc
 from ..utils.resilience import (
     REQUEST_ID_METADATA_KEY,
@@ -68,6 +69,7 @@ class LMSClient:
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         seed: Optional[int] = None,
+        group_of: Optional[Callable[[str], int]] = None,
     ):
         self.servers = list(servers)
         self.discovery_rounds = discovery_rounds
@@ -84,7 +86,15 @@ class LMSClient:
         self.token: Optional[str] = None
         self.role: Optional[str] = None
         self._channels: Dict[str, grpc.Channel] = {}
-        self._leader_addr: Optional[str] = None
+        # Leader hints keyed by Raft GROUP (sharded control plane, PR 16).
+        # Lane 0 is the meta group — the only lane a single-group cluster
+        # ever uses, so this stays behavior-identical there. Against a
+        # sharded cluster, `group_of` (username → home group) picks the
+        # lane per logical op, and a failed RPC distrusts ONLY that lane:
+        # losing group 2's leader must not blow away good hints for 0/1.
+        self._group_of = group_of
+        self._username: Optional[str] = None
+        self._leader_hints: Dict[int, str] = {}
         # Leader addresses learned over the wire (GetLeader) that the boot
         # list doesn't contain — a server added by a runtime membership
         # change. Probed during discovery so the client can follow the
@@ -110,8 +120,29 @@ class LMSClient:
             ch.close()
         self._channels.clear()
 
-    def _set_leader(self, addr: str) -> str:
-        self._leader_addr = addr
+    @property
+    def _leader_addr(self) -> Optional[str]:
+        """Back-compat view of the meta-group (lane 0) hint."""
+        return self._leader_hints.get(0)
+
+    @_leader_addr.setter
+    def _leader_addr(self, addr: Optional[str]) -> None:
+        if addr is None:
+            self._leader_hints.pop(0, None)
+        else:
+            self._leader_hints[0] = addr
+
+    def _home_group(self) -> int:
+        """The logged-in user's home Raft group (lane 0 when unknown)."""
+        if self._group_of is not None and self._username:
+            try:
+                return max(0, int(self._group_of(self._username)))
+            except (TypeError, ValueError):
+                return 0
+        return 0
+
+    def _set_leader(self, addr: str, group: int = 0) -> str:
+        self._leader_hints[group] = addr
         if addr not in self.servers and addr not in self._extra_servers:
             # A leader the boot list doesn't know (membership-added node):
             # remember it as a discovery peer of its own, so the client
@@ -119,25 +150,38 @@ class LMSClient:
             self._extra_servers.append(addr)
         return addr
 
-    def evict_leader_hint(self, addr: Optional[str] = None) -> None:
-        """Drop the cached leader hint (all hints, or only `addr`). Called
-        when the hinted node fails an RPC — it may have been removed by a
-        membership change, restarted, or deposed — so the next op
-        re-discovers from any live peer instead of re-dialing a corpse.
+    def evict_leader_hint(self, addr: Optional[str] = None,
+                          group: Optional[int] = None) -> None:
+        """Drop cached leader hints. Called when a hinted node fails an
+        RPC — it may have been removed by a membership change, restarted,
+        or deposed — so the next op re-discovers from any live peer
+        instead of re-dialing a corpse.
+
+        Distrust is scoped: with `group` given, only that group's lane is
+        dropped; with only `addr`, every lane currently pointing at that
+        address is dropped (but other groups' healthy hints survive);
+        with neither, everything goes.
 
         A wire-learned (off-boot-list) address is also dropped from the
         discovery peers: without this the list grows without bound under
         membership churn and every sweep keeps probing removed nodes. If
         the node is alive and leads again, the next GetLeader re-learns
         it."""
-        if addr is None or self._leader_addr == addr:
-            self._leader_addr = None
+        if group is not None:
+            hinted = self._leader_hints.get(group)
+            if addr is None or hinted == addr:
+                self._leader_hints.pop(group, None)
+        elif addr is None:
+            self._leader_hints.clear()
+        else:
+            for lane in [g for g, a in self._leader_hints.items() if a == addr]:
+                self._leader_hints.pop(lane, None)
         if addr is not None and addr in self._extra_servers:
             self._extra_servers.remove(addr)
 
     def discover_leader(
         self, force: bool = False, deadline: Optional[Deadline] = None,
-        avoid: Optional[str] = None,
+        avoid: Optional[str] = None, group: Optional[int] = None,
     ) -> str:
         """Address of the current leader (cached until an RPC fails).
 
@@ -151,9 +195,17 @@ class LMSClient:
         else, the avoided address is accepted after all (the failure may
         have been transient), so discovery degrades gracefully instead of
         blacklisting a healthy node.
+
+        `group` selects the hint lane (default: the logged-in user's home
+        group). Discovery itself names the meta-group leader — ANY router
+        node accepts and forwards every RPC — so against a sharded
+        cluster each lane converges on the entry point that served it
+        last, and eviction on failure is per group.
         """
-        if self._leader_addr and not force:
-            return self._leader_addr
+        lane = self._home_group() if group is None else group
+        hinted = self._leader_hints.get(lane)
+        if hinted and not force:
+            return hinted
         for attempt in range(self.discovery_rounds):
             # Probe healthy candidates first; the just-failed node last.
             order = [a for a in (*self.servers, *self._extra_servers)
@@ -178,20 +230,20 @@ class LMSClient:
                         if resp.nodeAddress == avoid and attempt == 0:
                             fallback = resp.nodeAddress
                             continue
-                        return self._set_leader(resp.nodeAddress)
+                        return self._set_leader(resp.nodeAddress, lane)
                     who = stub.WhoIsLeader(lms_pb2.Empty(), timeout=probe_timeout)
                     if 0 < who.leader_id <= len(self.servers):
                         cand = self.servers[who.leader_id - 1]
                         if cand == avoid and attempt == 0:
                             fallback = cand
                             continue
-                        return self._set_leader(cand)
+                        return self._set_leader(cand, lane)
                 except grpc.RpcError:
                     continue
             if fallback is not None:
                 # Every live peer still names the avoided address and a
                 # full sweep found no alternative: trust it after all.
-                return self._set_leader(fallback)
+                return self._set_leader(fallback, lane)
             sleep_s = jittered_backoff(
                 attempt, base_s=self.discovery_backoff_s,
                 cap_s=self.discovery_backoff_s * 4, rng=self._rng,
@@ -249,13 +301,15 @@ class LMSClient:
     ) -> T:
         last_error: Optional[Exception] = None
         avoid: Optional[str] = None
+        lane = self._home_group()
         for attempt in range(self.rpc_retries + 1):
             if deadline.expired:
                 break
             addr = None
             try:
                 addr = self.discover_leader(force=attempt > 0,
-                                            deadline=deadline, avoid=avoid)
+                                            deadline=deadline, avoid=avoid,
+                                            group=lane)
                 stub = rpc.LMSStub(self._channel(addr))
                 timeout = max(0.001, deadline.timeout(cap=cap))
                 return fn(stub, timeout, deadline)
@@ -268,8 +322,9 @@ class LMSClient:
                     # away from the failed node: mid-churn (a membership
                     # remove, a rolling restart) stale peers may keep
                     # naming it, and re-trusting them first would pin every
-                    # retry on the same dead address.
-                    self.evict_leader_hint(addr)
+                    # retry on the same dead address. Distrust is scoped to
+                    # this op's group lane — other groups keep their hints.
+                    self.evict_leader_hint(addr, group=lane)
                     avoid = addr
                 log.info("rpc failed (%s); re-resolving leader", e.code())
                 if attempt >= self.rpc_retries:
@@ -297,8 +352,8 @@ class LMSClient:
         """Idempotency key for one logical mutation (stable across retries)."""
         return uuid.uuid4().hex
 
-    @staticmethod
-    def _md(deadline: Optional[Deadline], request_id: Optional[str] = None):
+    def _md(self, deadline: Optional[Deadline],
+            request_id: Optional[str] = None):
         """Per-attempt metadata: the live deadline budget, plus (when given)
         the logical request id — the SAME id on every retry, so server-side
         mutations made on this request's behalf (the degraded instructor
@@ -306,6 +361,12 @@ class LMSClient:
         md = deadline.to_metadata() if deadline is not None else []
         if request_id:
             md = md + [(REQUEST_ID_METADATA_KEY, request_id)]
+        if self.token and self._username:
+            # Routing HINT for the sharded control plane: lets a router
+            # whose local session replicas lag still home-route the op.
+            # Auth stays with the token — a wrong hint only mis-routes to
+            # a group that rejects it.
+            md = md + [(USER_METADATA_KEY, self._username)]
         # The trace context rides the same metadata: each attempt carries
         # the client span's position so server fragments graft under it.
         return trace_metadata(md)
@@ -334,6 +395,7 @@ class LMSClient:
         if resp.success:
             self.token = resp.token
             self.role = resp.role
+            self._username = username
         return resp.success
 
     def logout(self) -> bool:

@@ -283,6 +283,28 @@ class StorageConfig:
 
 
 @dataclasses.dataclass
+class GroupsConfig:
+    """[groups] — the sharded control plane: N independent Raft groups
+    hosting partitioned LMS state behind the course-keyed router
+    (lms/group_router.py). `count = 1` (or the section absent) keeps the
+    single-group world byte-compatible: no router, no extra Raft ports,
+    existing WAL/snapshot files load unchanged. With `count > 1` every
+    server hosts one member of EVERY group (group 0 doubles as the meta
+    group holding the replicated routing map) and each extra group's
+    Raft plane listens at the node's base port + `port_stride * gid`.
+    """
+
+    count: int = 1          # Raft groups (1 = today's single-group world)
+    port_stride: int = 1000  # group gid's Raft port = base + stride * gid
+
+    def __post_init__(self) -> None:
+        if self.count < 1:
+            raise ValueError("[groups] count must be >= 1")
+        if self.port_stride < 1:
+            raise ValueError("[groups] port_stride must be >= 1")
+
+
+@dataclasses.dataclass
 class SimConfig:
     """[sim] — the semester simulator (sim/): one continuously-verified
     production scenario composing the whole fault arsenal under SLOs.
@@ -340,10 +362,18 @@ class SimConfig:
     telemetry_sample_s: float = 0.25  # scrape/evaluate cadence of the
     #                               in-run telemetry loop (cluster /metrics
     #                               poll + burn-rate evaluation)
+    lms_groups: int = 1           # Raft groups hosting the sharded LMS
+    #                               state (lms/group_router.py); > 1 boots
+    #                               the router + per-group Raft planes and
+    #                               adds the group drills (per-group
+    #                               leader loss, live split mid-peak) to
+    #                               the operations schedule
 
     def __post_init__(self) -> None:
         if self.telemetry_sample_s <= 0:
             raise ValueError("[sim] telemetry_sample_s must be > 0")
+        if self.lms_groups < 1:
+            raise ValueError("[sim] lms_groups must be >= 1")
         if self.tutoring_engine not in ("echo", "tiny", "tiny-paged"):
             raise ValueError(
                 f"[sim] tutoring_engine must be 'echo', 'tiny', or "
@@ -445,6 +475,7 @@ class AppConfig:
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
     )
+    groups: GroupsConfig = dataclasses.field(default_factory=GroupsConfig)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     sim: SimConfig = dataclasses.field(default_factory=SimConfig)
     tracing: TracingConfig = dataclasses.field(default_factory=TracingConfig)
@@ -474,7 +505,8 @@ def load_config(path: str) -> AppConfig:
         raw = tomllib.load(fh)
     unknown = set(raw) - {"cluster", "tutoring", "tutoring_fleet",
                           "sampling", "scoring", "gate", "resilience",
-                          "storage", "sim", "tracing", "telemetry"}
+                          "groups", "storage", "sim", "tracing",
+                          "telemetry"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -498,6 +530,7 @@ def load_config(path: str) -> AppConfig:
         gate=_build(GateConfig, dict(raw.get("gate", {})), "gate"),
         resilience=_build(ResilienceConfig, dict(raw.get("resilience", {})),
                           "resilience"),
+        groups=_build(GroupsConfig, dict(raw.get("groups", {})), "groups"),
         storage=_build(StorageConfig, dict(raw.get("storage", {})),
                        "storage"),
         sim=_build(SimConfig, dict(raw.get("sim", {})), "sim"),

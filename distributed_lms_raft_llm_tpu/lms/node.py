@@ -54,7 +54,18 @@ class LMSNode:
         storage_checksums: bool = True,
         storage_fsync: bool = True,
         storage_recovery: str = "rejoin",
+        blobs=None,
+        blob_addresses: Optional[Dict[int, str]] = None,
+        fault_prefix: str = "raft",
     ):
+        # Multi-group hosting (lms/group_router.py): a non-zero group's
+        # LMSNode shares the primary node's BlobStore (`blobs=`) — blob
+        # bytes are node-scoped, only metadata shards — and replicates
+        # files over the BASE LMS ports (`blob_addresses=`), since the
+        # per-group Raft ports carry no FileTransfer servicer. Its chaos
+        # target namespace is `fault_prefix` (`raft:<gid>`), so campaigns
+        # can kill one group's leader while the others keep serving. The
+        # defaults keep a single-group node byte-identical to before.
         # snapshot_every > 1 amortizes the full-state JSON rewrite (the WAL
         # already guarantees durability; on crash, at most snapshot_every
         # entries replay). The reference rewrote everything per command.
@@ -82,8 +93,11 @@ class LMSNode:
 
         snap_path = os.path.join(data_dir, "lms_data.json")
         wal_path = os.path.join(data_dir, "raft_wal.jsonl")
-        self.blobs = BlobStore(os.path.join(data_dir, "uploads"),
-                               fs=fs, metrics=metrics)
+        self._owns_blobs = blobs is None
+        self.blobs = blobs if blobs is not None else BlobStore(
+            os.path.join(data_dir, "uploads"), fs=fs, metrics=metrics
+        )
+        self._blob_addresses = blob_addresses
         # Recovery mode must survive a crash MID-recovery: the quarantine
         # leaves clean (empty) stores behind, so without a durable marker
         # the next boot would resume normal voting before the re-sync
@@ -155,7 +169,8 @@ class LMSNode:
             # on the live Raft egress, driven by the admin endpoint.
             from ..utils.faults import FaultyTransport
 
-            transport = FaultyTransport(transport, fault_injector)
+            transport = FaultyTransport(transport, fault_injector,
+                                        prefix=fault_prefix)
         cfg = raft_config or RaftConfig()
         self.node = RaftNode(
             node_id,
@@ -213,6 +228,11 @@ class LMSNode:
         not serve them. Quarantined blobs heal via fetch-on-miss once the
         metadata re-replicates (a quorum of healthy peers holds every
         acked upload)."""
+        if not self._owns_blobs:
+            # Shared store (multi-group hosting): the PRIMARY node owns
+            # the blob tree and its quarantine lifecycle; a group member
+            # finding ITS log corrupt says nothing about the shared blobs.
+            return
         fs = self._fs
         uploads_dir = os.path.join(data_dir, "uploads")
         if not fs.exists(uploads_dir):
@@ -279,7 +299,11 @@ class LMSNode:
             rel = args["filepath"]
             task = asyncio.ensure_future(
                 replicate_file_to_peers(
-                    self.addresses, self.node_id, self.blobs, rel,
+                    # Group members stream blobs over the base LMS ports
+                    # (their own Raft ports carry no FileTransfer plane).
+                    self._blob_addresses if self._blob_addresses is not None
+                    else self.addresses,
+                    self.node_id, self.blobs, rel,
                     per_peer_timeout_s=self._replicate_timeout_s,
                     # One budget for the whole sweep: a wedged follower
                     # cannot stack per-peer caps into minutes of leader

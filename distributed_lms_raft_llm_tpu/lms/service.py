@@ -53,6 +53,7 @@ from ..utils.tracing import (
     trace_metadata,
     traced_grpc_handler,
 )
+from .group_router import AUTH_SALT_METADATA_KEY, AUTH_TOKEN_METADATA_KEY
 from .persistence import BlobStore
 from .state import LMSState, hash_password
 from .tutoring_pool import TutoringPool, TutoringUnavailable
@@ -60,6 +61,17 @@ from .tutoring_pool import TutoringPool, TutoringUnavailable
 log = logging.getLogger(__name__)
 
 CHUNK_SIZE = 1024 * 1024  # reference streams 1 MB chunks (lms_server.py:1467)
+
+
+def _forced_auth(context, key: str) -> Optional[str]:
+    """Auth material pinned by the group router's replicated-auth fan-out
+    (lms/group_router.py): the entry router mints ONE salt/token and
+    forces it onto every group's Register/Login leg so credentials and
+    sessions converge across groups. Absent outside multi-group routing."""
+    for k, v in context.invocation_metadata() or ():
+        if k == key and v:
+            return str(v)
+    return None
 
 
 def collect_submission_texts(state: "LMSState",
@@ -355,13 +367,23 @@ class LMSServicer(rpc.LMSServicer):
                 success=False, message="Role must be student or instructor."
             )
         if request.username in self.state.data["users"]:
-            return lms_pb2.RegisterResponse(
-                success=False, message=f"User {request.username} already exists."
-            )
+            # Same credentials re-registering is an idempotent retry (the
+            # router's replicated-auth fan-out retries the whole op when
+            # one group's leg fails) — fall through and succeed. Anything
+            # else is a genuine conflict.
+            if not (
+                self.state.check_password(request.username, request.password)
+                and self.state.role_of(request.username) == request.role
+            ):
+                return lms_pb2.RegisterResponse(
+                    success=False,
+                    message=f"User {request.username} already exists.",
+                )
         # Salt generated here, carried in the command: every replica applies
         # the same (salt, hash) pair, so the KDF stays deterministic across
-        # the cluster while each user gets a unique salt.
-        salt = os.urandom(16).hex()
+        # the cluster while each user gets a unique salt. The group router
+        # forces one salt across its per-group legs.
+        salt = _forced_auth(context, AUTH_SALT_METADATA_KEY) or os.urandom(16).hex()
         pw_hash = hash_password(request.password, salt)
         await self._propose(
             "Register",
@@ -394,7 +416,7 @@ class LMSServicer(rpc.LMSServicer):
         self.metrics.inc("login")
         if not self.state.check_password(request.username, request.password):
             return lms_pb2.LoginResponse(success=False)
-        token = uuid.uuid4().hex
+        token = _forced_auth(context, AUTH_TOKEN_METADATA_KEY) or uuid.uuid4().hex
         await self._propose(
             "Login", {"username": request.username, "token": token}, context
         )

@@ -34,11 +34,18 @@ from typing import Callable, Dict, List, Optional, Tuple
 import grpc
 
 from ..config import SimConfig
+from ..lms.group_router import (
+    ROUTING_MAP_KEY,
+    GroupsAdmin,
+    ReshardCoordinator,
+    RoutedLMSServicer,
+    RoutingMap,
+)
 from ..lms.node import LMSNode
 from ..lms.service import FileTransferServicer, LMSServicer
 from ..lms.tutoring_pool import TutoringPool
 from ..proto import rpc
-from ..raft import RaftConfig
+from ..raft import NotLeader, RaftConfig, encode_command
 from ..raft.grpc_transport import RaftServicer
 from ..serving.lms_server import make_admin, make_health
 from ..serving.tutoring_server import (
@@ -52,6 +59,7 @@ from ..utils.guards import make_serving_watchdog
 from ..utils.healthz import HealthServer
 from ..utils.metrics import Metrics
 from ..utils.timeline import TimelineSampler
+from .workload import WorkloadGenerator
 
 log = logging.getLogger(__name__)
 
@@ -181,6 +189,16 @@ class SimCluster:
         self._tutoring: Dict[int, Dict] = {}     # guarded-by: _lock
         self._tutoring_addrs: Dict[int, str] = {}        # guarded-by: _lock
         self._tutoring_health: Dict[int, str] = {}       # guarded-by: _lock
+        # Sharded control plane ([sim] lms_groups > 1): per-(group, node)
+        # Raft ports, pinned like the base ports so restarts come back at
+        # the same advertised address.
+        self._group_ports: Dict[Tuple[int, int], int] = {}  # guarded-by: _lock
+        # The workload's static course assignment doubles as the router's
+        # course_of — routing map and traffic agree on who lives where.
+        self._wgen = WorkloadGenerator(cfg)
+        self._initial_map = RoutingMap.initial(
+            max(1, cfg.lms_groups), self._wgen.courses
+        )
 
     # ------------------------------------------------------------ lifecycle
 
@@ -200,6 +218,9 @@ class SimCluster:
             self._run(self._boot_node(nid), timeout=60.0)
         if self.wait_leader(timeout=20.0) is None:
             raise RuntimeError("sim cluster elected no leader")
+        for gid in range(1, self.group_count()):
+            if self.wait_group_leader(gid, timeout=20.0) is None:
+                raise RuntimeError(f"raft group {gid} elected no leader")
 
     def stop(self) -> None:
         for nid in list(self._nodes):
@@ -243,6 +264,95 @@ class SimCluster:
     def health_port(self, nid: int) -> int:
         with self._lock:
             return self._health_ports[nid]
+
+    # ------------------------------------------------------- group topology
+
+    def group_count(self) -> int:
+        return max(1, self.cfg.lms_groups)
+
+    def course_of(self, actor: str) -> str:
+        return self._wgen.course_of(actor)
+
+    def group_of(self, actor: str) -> int:
+        """Static hint-lane assignment for clients (the INITIAL map —
+        lanes only partition the leader-hint cache, so a post-reshard
+        client landing on its old lane is merely a cold cache, never a
+        correctness issue; the router re-routes every request against
+        the live replicated map)."""
+        if self.group_count() <= 1:
+            return 0
+        return self._initial_map.group_for(actor, self._wgen.course_of)
+
+    def live_group_of(self, actor: str) -> int:
+        """`actor`'s owning group per the LIVE replicated routing map
+        (falls back to the initial map before the first flip) — what the
+        ledger tags acked writes with, so the audit knows which writes
+        crossed a resharding boundary."""
+        if self.group_count() <= 1:
+            return 0
+        raw = None
+        with self._lock:
+            recs = list(self._nodes.values())
+        for rec in recs:
+            gnode = rec.get("groups", {}).get(0)
+            if gnode is None:
+                continue
+            candidate = gnode.state.data["kv"].get(ROUTING_MAP_KEY)
+            if candidate:
+                raw = candidate
+                if gnode.node.is_leader:
+                    break
+        m = RoutingMap.from_json(raw) if raw else self._initial_map
+        return m.group_for(actor, self._wgen.course_of)
+
+    def _group_addrs_locked(self, gid: int) -> Dict[int, str]:  # guarded-by: _lock
+        """Pin (allocate-once) group `gid`'s Raft port for every known
+        node id. Caller holds `_lock`."""
+        out: Dict[int, str] = {}
+        for nid in self._addresses:
+            key = (gid, nid)
+            if key not in self._group_ports:
+                self._group_ports[key] = _free_port()
+            out[nid] = f"127.0.0.1:{self._group_ports[key]}"
+        return out
+
+    def group_topology(self, nid: int) -> Dict:
+        """GET /admin/raft on one node — the routing map + per-group
+        members/leader/term/applied rows the dashboard renders."""
+        return self.admin_get(nid, "/admin/raft")
+
+    def group_leader(self, gid: int) -> Optional[int]:
+        for nid in self.node_ids():
+            with self._lock:
+                rec = self._nodes.get(nid)
+            if rec is None:
+                continue
+            gnode = rec.get("groups", {}).get(gid)
+            if gnode is not None and gnode.node.is_leader:
+                return nid
+        return None
+
+    def wait_group_leader(self, gid: int, timeout: float) -> Optional[int]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            nid = self.group_leader(gid)
+            if nid is not None:
+                return nid
+            time.sleep(0.05)
+        return None
+
+    def routing_map_doc(self, nid: Optional[int] = None) -> Dict:
+        target = nid if nid is not None else self.node_ids()[0]
+        return dict(self.group_topology(target).get("routing_map", {}))
+
+    def reshard(self, course: str, to_group: int) -> Dict:
+        """Drive a live course split through the REAL admin plane (the
+        coordinator journals every step in the meta group)."""
+        nid = self.wait_leader(timeout=15.0)
+        if nid is None:
+            raise RuntimeError("no leader to accept /admin/reshard")
+        return self.admin_post(nid, "/admin/reshard",
+                               {"course": course, "to_group": to_group})
 
     # -------------------------------------------------------- node control
 
@@ -624,6 +734,32 @@ class SimCluster:
             fault_injector=faults, disk_fault_injector=disk_faults,
             metrics=metrics,
         )
+        # Sharded control plane: group 0 IS the base node (meta group +
+        # byte-compatible data group); gids >= 1 are extra Raft groups on
+        # this node with their own ports/WALs. They share the node's blob
+        # store and fault injector — their chaos namespace is `raft:<gid>`
+        # so a campaign can sever ONE group's quorum links while the
+        # others keep serving.
+        groups: Dict[int, LMSNode] = {0: lms_node}
+        if cfg.lms_groups > 1:
+            with self._lock:
+                group_addrs = {
+                    gid: self._group_addrs_locked(gid)
+                    for gid in range(1, cfg.lms_groups)
+                }
+            for gid in range(1, cfg.lms_groups):
+                groups[gid] = LMSNode(
+                    nid, group_addrs[gid],
+                    f"{self.workdir}/node{nid}/group{gid}",
+                    raft_config=SIM_RAFT,
+                    snapshot_every=SIM_SNAPSHOT_EVERY,
+                    fault_injector=faults,
+                    disk_fault_injector=disk_faults,
+                    metrics=metrics,
+                    blobs=lms_node.blobs,
+                    blob_addresses=lms_node.addresses,
+                    fault_prefix=f"raft:{gid}",
+                )
         # The tutoring routing tier, fleet-sized to [sim] tutoring_nodes:
         # sim-scale spill/hedge/warm-up knobs so the drills resolve
         # inside a seconds-long run (hedge after 100 ms, 1 s warm-up,
@@ -642,21 +778,36 @@ class SimCluster:
             warmup_s=1.0,
             health_poll_s=0.2,
         )
-        servicer = LMSServicer(
-            lms_node.node, lms_node.state, lms_node.blobs,
-            gate=KeywordGate(),
-            metrics=metrics,
-            peer_addresses=lms_node.addresses,
-            self_id=nid,
-            fault_injector=faults,
-            tutoring_timeout_s=min(30.0, cfg.llm_budget_s),
-            deadline_floor_s=0.25,
-            tutoring_pool=pool,
-        )
+        def _servicer(gnode: LMSNode) -> LMSServicer:
+            return LMSServicer(
+                gnode.node, gnode.state, lms_node.blobs,
+                gate=KeywordGate(),
+                metrics=metrics,
+                peer_addresses=lms_node.addresses,
+                self_id=nid,
+                fault_injector=faults,
+                tutoring_timeout_s=min(30.0, cfg.llm_budget_s),
+                deadline_floor_s=0.25,
+                tutoring_pool=pool,
+            )
+
+        servicer = _servicer(lms_node)
         server = grpc.aio.server(
             options=[("grpc.max_receive_message_length", 50 * 1024 * 1024)]
         )
-        rpc.add_LMSServicer_to_server(servicer, server)
+        router: Optional[RoutedLMSServicer] = None
+        if cfg.lms_groups > 1:
+            inner = {gid: (servicer if gid == 0 else _servicer(gnode))
+                     for gid, gnode in groups.items()}
+            router = RoutedLMSServicer(
+                groups, inner, lms_node.addresses, nid,
+                course_of=self._wgen.course_of,
+                initial_map=self._initial_map,
+                metrics=metrics,
+            )
+            rpc.add_LMSServicer_to_server(router, server)
+        else:
+            rpc.add_LMSServicer_to_server(servicer, server)
         rpc.add_RaftServiceServicer_to_server(
             # Live map: membership-added peers must be reported by
             # GetLeader (client leader-hint re-discovery depends on it).
@@ -671,7 +822,32 @@ class SimCluster:
         if bound != port:
             raise RuntimeError(f"node {nid}: wanted port {port}, got {bound}")
         await server.start()
+        # Per-group Raft wire: one small gRPC server per extra group (the
+        # proto carries no group id, so each group needs its own port).
+        # Servers come up before any group node starts campaigning.
+        group_servers: Dict[int, grpc.aio.Server] = {}
+        for gid, gnode in sorted(groups.items()):
+            if gid == 0:
+                continue
+            gserver = grpc.aio.server()
+            rpc.add_RaftServiceServicer_to_server(
+                RaftServicer(gnode.node, gnode.addresses,
+                             kv=gnode.state.data["kv"]),
+                gserver,
+            )
+            with self._lock:
+                gport = self._group_ports[(gid, nid)]
+            gbound = gserver.add_insecure_port(f"127.0.0.1:{gport}")
+            if gbound != gport:
+                raise RuntimeError(
+                    f"node {nid} group {gid}: wanted port {gport}, "
+                    f"got {gbound}"
+                )
+            await gserver.start()
+            group_servers[gid] = gserver
         await lms_node.start()
+        for gid in sorted(group_servers):
+            await groups[gid].start()
         campaigns = CampaignRunner(faults, disk_faults, metrics=metrics)
         # Same node-local telemetry timeline the production entrypoint
         # samples, served at GET /admin/timeline per node.
@@ -680,10 +856,22 @@ class SimCluster:
         # The router's drain-aware health poller, like the production
         # entrypoint starts.
         pool.start()
+        coordinator = None
+        if cfg.lms_groups > 1:
+            # Cluster-level coordinator: proposals land on each group's
+            # CURRENT leader (in-process — the sim runs every node on
+            # this loop), so /admin/reshard works from any node.
+            coordinator = ReshardCoordinator(
+                ClusterGroupAccess(self),
+                course_of=self._wgen.course_of,
+                metrics=metrics,
+            )
+        groups_admin = GroupsAdmin(groups, router=router,
+                                   coordinator=coordinator)
         admin, admin_get = make_admin(lms_node, faults, disk_faults,
                                       campaigns,
                                       timeline=sampler.timeline,
-                                      pool=pool)
+                                      pool=pool, groups_admin=groups_admin)
         health = HealthServer(
             metrics,
             health=make_health(nid, lms_node, pool, faults),
@@ -703,6 +891,8 @@ class SimCluster:
                 "campaigns": campaigns, "metrics": metrics,
                 "pool": pool, "watchdog": watchdog,
                 "sampler": sampler,
+                "groups": groups, "group_servers": group_servers,
+                "router": router,
             }
 
     async def _stop_node(self, nid: int) -> None:
@@ -715,5 +905,105 @@ class SimCluster:
         rec["sampler"].stop()
         await rec["pool"].close()
         await rec["health"].stop()
+        if rec.get("router") is not None:
+            await rec["router"].close()
+        for gid in sorted(rec.get("groups", {}), reverse=True):
+            if gid != 0:
+                await rec["groups"][gid].stop()
         await rec["lms_node"].stop()
         await rec["server"].stop(None)
+        for _gid, gserver in sorted(rec.get("group_servers", {}).items()):
+            await gserver.stop(None)
+
+
+class ClusterGroupAccess:
+    """`GroupAccess` over the live cluster: the reshard coordinator's
+    proposals chase each group's CURRENT leader replica through
+    elections (every sim node shares one loop, so the leader's LMSNode
+    is directly reachable in-process — the same way a production
+    coordinator would follow NotLeader redirects over the wire)."""
+
+    def __init__(self, cluster: SimCluster) -> None:
+        self._cluster = cluster
+
+    def n_groups(self) -> int:
+        return self._cluster.group_count()
+
+    def _records(self) -> List[Dict]:
+        with self._cluster._lock:
+            return list(self._cluster._nodes.values())
+
+    def _leader_node(self, gid: int) -> Optional[LMSNode]:
+        for rec in self._records():
+            gnode = rec.get("groups", {}).get(gid)
+            if (gnode is not None and gnode.node.is_leader
+                    and not gnode.recovering):
+                return gnode
+        return None
+
+    async def _leader(self, gid: int, timeout: float = 15.0) -> LMSNode:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            gnode = self._leader_node(gid)
+            if gnode is not None:
+                return gnode
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"group {gid}: no leader within {timeout}s")
+
+    def users(self) -> List[str]:
+        # Auth is replicated to every group (router fan-out), so any
+        # replica's user table is a superset view; union to be safe
+        # against a lagging follower.
+        names: set = set()
+        for rec in self._records():
+            for gnode in rec.get("groups", {}).values():
+                names.update(gnode.state.data["users"].keys())
+        return sorted(names)
+
+    def state(self, gid: int):
+        gnode = self._leader_node(gid)
+        if gnode is None:
+            raise RuntimeError(f"group {gid}: no leader replica to read")
+        return gnode.state
+
+    def current_map(self) -> RoutingMap:
+        gnode = self._leader_node(0)
+        if gnode is None:
+            for rec in self._records():
+                gnode = rec.get("groups", {}).get(0)
+                if gnode is not None:
+                    break
+        raw = (gnode.state.data["kv"].get(ROUTING_MAP_KEY)
+               if gnode is not None else None)
+        if raw:
+            return RoutingMap.from_json(raw)
+        return self._cluster._initial_map
+
+    async def read_fence(self, gid: int) -> None:
+        gnode = await self._leader(gid)
+        await gnode.node.read_barrier()
+
+    async def propose(self, gid: int, op: str, args: Dict) -> None:
+        deadline = time.monotonic() + 30.0
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            gnode = await self._leader(gid)
+            try:
+                await gnode.node.propose(encode_command(op, args))
+                return
+            except (NotLeader, TimeoutError, asyncio.TimeoutError) as e:
+                # Mid-handoff leader churn (the drills induce it on
+                # purpose): re-resolve and re-propose. Deterministic
+                # request_ids make the replay idempotent.
+                last = e
+                await asyncio.sleep(0.05)
+        raise TimeoutError(f"group {gid}: {op} not committed ({last})")
+
+    async def meta_get(self, key: str) -> Optional[str]:
+        gnode = await self._leader(0)
+        await gnode.node.read_barrier()
+        val = gnode.state.data["kv"].get(key)
+        return None if val is None else str(val)
+
+    async def meta_set(self, key: str, value: str) -> None:
+        await self.propose(0, "SetVal", {"key": key, "value": value})

@@ -49,6 +49,14 @@ TUTORING_AUTOSCALE = "tutoring_autoscale"
 # scoring tenant via the LMS admin plane, mid-run, while student traffic
 # keeps flowing. The job must COMPLETE; interactive p95 must not move.
 BULK_GRADING = "bulk_grading_night"
+# Sharded-control-plane drills ([sim] lms_groups > 1): sever ONE Raft
+# group's quorum links on its leader via the per-group fault target
+# `raft:<gid>` (the other groups must keep serving and the group must
+# re-elect), and a live group split — POST /admin/reshard moves a course
+# between groups mid-diurnal-peak, under a network-chaos overlay, with
+# the routing-map flip verified on every node.
+GROUP_LEADER_LOSS = "group_leader_loss"
+GROUP_SPLIT = "group_split"
 
 # Events that are OPERATIONS, not faults: the continuous SLO engine
 # classifies burn alerts against fault windows only, so a latency alert
@@ -129,6 +137,23 @@ def plan_events(cfg: SimConfig) -> List[SimEvent]:
             at_s=_jitter(rng, 0.26, 0.02) * T, kind=BULK_GRADING,
             params={"timeout_s": round(max(6.0, 0.4 * T), 3)},
         ))
+    if cfg.lms_groups > 1:
+        # Group drills straddle the diurnal PEAK (0.5T): the leader loss
+        # lands just before it, the live split right on it — the handoff
+        # has to freeze/stream/flip while traffic is at its densest and a
+        # chaos overlay shapes the wires.
+        events += [
+            SimEvent(
+                at_s=_jitter(rng, 0.45, 0.02) * T, kind=GROUP_LEADER_LOSS,
+                params={"gid": 1,
+                        "hold_s": round(max(1.2, 0.05 * T), 3)},
+            ),
+            SimEvent(
+                at_s=_jitter(rng, 0.52, 0.02) * T, kind=GROUP_SPLIT,
+                params={"course": 0,
+                        "chaos_s": round(max(2.0, 0.10 * T), 3)},
+            ),
+        ]
     if cfg.tutoring_nodes > 1:
         # Fleet drills land AFTER the rolling restart (0.38T): the node
         # that routes (and counts hedges/spills) must not be restarted
@@ -162,12 +187,13 @@ class OperationsScheduler:
     """
 
     def __init__(self, cluster, plan: List[SimEvent], *, metrics=None,
-                 writer=None, asker=None):
+                 writer=None, asker=None, ledger=None):
         self.cluster = cluster
         self.plan = sorted(plan, key=lambda e: e.at_s)
         self.metrics = metrics
         self.writer = writer
         self.asker = asker
+        self.ledger = ledger
         self.outcomes: List[Dict] = []   # guarded-by: _lock
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -233,6 +259,8 @@ class OperationsScheduler:
                     TUTORING_DRAIN: self._tutoring_drain,
                     TUTORING_AUTOSCALE: self._tutoring_autoscale,
                     BULK_GRADING: self._bulk_grading,
+                    GROUP_LEADER_LOSS: self._group_leader_loss,
+                    GROUP_SPLIT: self._group_split,
                 }[event.kind]
                 outcome["detail"] = handler(event)
                 outcome["ok"] = True
@@ -492,6 +520,95 @@ class OperationsScheduler:
                 f" preemptible quanta on {resp.get('node')} "
                 f"({doc.get('scored_tokens')} tokens scored in the idle "
                 "lanes, interactive traffic untouched)")
+
+    # ------------------------------------------------------ group drills
+
+    def _group_is_leader(self, nid: int, gid: int) -> bool:
+        doc = self.cluster.group_topology(nid)
+        row = doc.get("groups", {}).get(str(gid), {})
+        return bool(row.get("is_leader"))
+
+    def _group_leader_loss(self, event: SimEvent) -> str:
+        """Sever ONE Raft group's quorum links on its leader via the
+        per-group fault target `raft:<gid>` (a timed campaign, the same
+        plane operators use). The group must re-elect on another node
+        while every OTHER group — including the meta group — keeps its
+        leader untouched."""
+        p = event.params
+        gid = int(p["gid"])
+        victim = self.cluster.wait_group_leader(gid, timeout=15.0)
+        if victim is None:
+            raise RuntimeError(f"group {gid} has no leader to kill")
+        self.cluster.admin_post(victim, "/admin/faults", {"campaign": {
+            "name": f"sim-group{gid}-leader-loss",
+            "phases": [{"target": f"raft:{gid}",
+                        "duration_s": p["hold_s"], "drop": 1.0}],
+        }})
+        t0 = time.monotonic()
+        new_leader = None
+        deadline = t0 + p["hold_s"] + 10.0
+        while time.monotonic() < deadline:
+            for nid in self.cluster.node_ids():
+                if nid == victim:
+                    continue
+                try:
+                    if self._group_is_leader(nid, gid):
+                        new_leader = nid
+                        break
+                except Exception:
+                    continue
+            if new_leader is not None:
+                break
+            time.sleep(0.05)
+        if new_leader is None:
+            raise RuntimeError(
+                f"group {gid} elected no replacement leader after its "
+                f"leader {victim} lost its group links"
+            )
+        # Wait out the campaign so event_windows covers the whole fault.
+        time.sleep(max(0.0, t0 + p["hold_s"] - time.monotonic()))
+        return (f"severed raft:{gid} on leader {victim} for "
+                f"{p['hold_s']}s; group re-elected node {new_leader}")
+
+    def _group_split(self, event: SimEvent) -> str:
+        """Live group split mid-diurnal-peak: move one course's key
+        range to the neighbor group through POST /admin/reshard — the
+        staged freeze/stream/flip handoff — while a network-chaos
+        overlay shapes every node's egress. The routing-map flip must
+        become visible on EVERY node's router."""
+        p = event.params
+        doc = self.cluster.routing_map_doc()
+        course = f"course{int(p['course'])}"
+        courses = doc.get("courses", {})
+        if course not in courses:
+            raise RuntimeError(
+                f"course {course!r} missing from routing map {doc}"
+            )
+        src = int(courses[course])
+        n_groups = int(doc.get("n_groups", 1))
+        dst = (src + 1) % n_groups
+        v0 = int(doc.get("version", 1))
+        for nid in self.cluster.node_ids():
+            self.cluster.admin_post(nid, "/admin/faults", {"campaign": {
+                "name": "sim-split-chaos",
+                "phases": [{"target": "*", "duration_s": p["chaos_s"],
+                            "drop": 0.05, "delay_s": 0.002}],
+            }})
+        resp = self.cluster.reshard(course, dst)
+        self._wait(
+            lambda: all(
+                int(self.cluster.routing_map_doc(nid).get("version", 0))
+                > v0
+                for nid in self.cluster.node_ids()
+            ),
+            15.0, "routing-map flip visible on every node",
+        )
+        if self.ledger is not None:
+            self.ledger.note_reshard(course, src, dst,
+                                     int(resp.get("version", v0 + 1)))
+        return (f"moved {course} group {src} -> {dst} under chaos "
+                f"(map v{v0} -> v{resp.get('version')}, "
+                f"{resp.get('moved_users')} users)")
 
     # ------------------------------------------------------ fleet drills
 

@@ -181,6 +181,7 @@ class SemesterSim:
             scheduler = ev.OperationsScheduler(
                 self.cluster, plan, metrics=self.metrics,
                 writer=self._bot_write, asker=self._bot_ask,
+                ledger=self.ledger,
             )
             t0 = time.monotonic()
             telemetry: Optional[_TelemetryLoop] = None
@@ -206,6 +207,7 @@ class SemesterSim:
             traces = get_tracer().records()
             fleet = self._fleet_summary(node_metrics, node_health)
             scoring = self._scoring_summary()
+            groups = self._groups_summary()
             report = evaluate_slos(
                 self.cfg, node_metrics, node_health,
                 self.metrics.snapshot(), self.ledger.report(),
@@ -217,11 +219,12 @@ class SemesterSim:
                             if telemetry is not None else None),
                 fleet=fleet,
                 scoring=scoring,
+                groups=groups,
             )
             return self._record(ops, plan, scheduler, report, node_metrics,
                                 traces, time.monotonic() - t_start,
                                 telemetry=telemetry, fleet=fleet,
-                                scoring=scoring)
+                                scoring=scoring, groups=groups)
         finally:
             for c in self._clients.values():
                 c.close()
@@ -235,6 +238,11 @@ class SemesterSim:
                     request_timeout_s: float = 15.0) -> LMSClient:
         return LMSClient(
             self.cluster.client_servers(),
+            # Sharded runs: clients key their leader-hint cache by Raft
+            # group (the static lane from the initial map), so evicting
+            # one group's distrusted hint leaves the others' warm.
+            group_of=(self.cluster.group_of
+                      if self.cfg.lms_groups > 1 else None),
             discovery_rounds=8, discovery_backoff_s=0.2,
             rpc_retries=6, rpc_timeout=5.0,
             request_timeout_s=request_timeout_s,
@@ -345,6 +353,14 @@ class SemesterSim:
 
     # ----------------------------------------------------------- scheduler IO
 
+    def _group_tag(self, actor: str):
+        """The actor's owning Raft group per the LIVE routing map (None
+        in single-group runs): stamped on every acked write so the
+        audit can name the writes that crossed a resharding boundary."""
+        if self.cfg.lms_groups <= 1:
+            return None
+        return self.cluster.live_group_of(actor)
+
     def _bot_write(self) -> bool:
         """One guaranteed acked write (the quarantine event's record
         source); ledger-tracked like any student write."""
@@ -354,7 +370,8 @@ class SemesterSim:
         query = f"ops bot write #{seq:04d}"
         try:
             if self._ops_bot.ask_instructor(query):
-                self.ledger.record(QUERY, ("ops_bot",), query)
+                self.ledger.record(QUERY, ("ops_bot",), query,
+                                   group=self._group_tag("ops_bot"))
                 return True
         except _CLIENT_ERRORS as e:
             log.info("ops bot write failed: %s", e)
@@ -371,7 +388,8 @@ class SemesterSim:
             return False
         if _is_degraded(resp):
             self.metrics.inc(metric.SIM_DEGRADED_ANSWERS)
-            self.ledger.record(QUERY, ("ops_bot",), ev.PROBE_QUERY)
+            self.ledger.record(QUERY, ("ops_bot",), ev.PROBE_QUERY,
+                               group=self._group_tag("ops_bot"))
             return True
         return False
 
@@ -438,21 +456,26 @@ class SemesterSim:
             data = pdf.make_pdf(payload["text"])
             if c.upload_course_material(payload["filename"], data):
                 self.ledger.record(MATERIAL, (payload["filename"],),
-                                   content_hash(data))
+                                   content_hash(data),
+                                   group=self._group_tag(op.actor))
         elif kind == wl.SUBMIT_ASSIGNMENT:
             data = pdf.make_pdf(payload["text"])
             if c.upload_assignment(payload["filename"], data):
                 self.ledger.record(ASSIGNMENT, (op.actor,
                                                 payload["filename"]),
-                                   content_hash(data))
+                                   content_hash(data),
+                                   group=self._group_tag(op.actor))
         elif kind == wl.GRADE:
             resp = c.grade(payload["student"], payload["grade"])
             if resp.success:
                 self.ledger.record(GRADE, (payload["student"],),
-                                   payload["grade"])
+                                   payload["grade"],
+                                   group=self._group_tag(
+                                       payload["student"]))
         elif kind == wl.ASK_INSTRUCTOR:
             if c.ask_instructor(payload["query"]):
-                self.ledger.record(QUERY, (op.actor,), payload["query"])
+                self.ledger.record(QUERY, (op.actor,), payload["query"],
+                                   group=self._group_tag(op.actor))
         elif kind in (wl.ASK_LLM_ON_TOPIC, wl.ASK_LLM_OFF_TOPIC):
             t1 = time.monotonic()
             try:
@@ -466,7 +489,8 @@ class SemesterSim:
                 # The degraded path IS a write: the query went onto the
                 # replicated instructor queue — hold the cluster to it.
                 self.metrics.inc(metric.SIM_DEGRADED_ANSWERS)
-                self.ledger.record(QUERY, (op.actor,), payload["query"])
+                self.ledger.record(QUERY, (op.actor,), payload["query"],
+                                   group=self._group_tag(op.actor))
             elif not resp.success:
                 raise SimOpFailed(f"ask_llm refused: {resp.response[:80]}")
         elif kind == wl.DOWNLOAD_MATERIAL:
@@ -607,6 +631,35 @@ class SemesterSim:
             "nodes": nodes,
         }
 
+    def _groups_summary(self) -> Optional[Dict]:
+        """Sharded-control-plane verdict inputs: the final routing map
+        and per-group topology (GET /admin/raft), per-group leaders from
+        the cluster's live records, and the ledger's reshard-boundary
+        evidence. None for a single-group run — the checks and record
+        fields only exist when there are groups to judge."""
+        if self.cfg.lms_groups <= 1:
+            return None
+        nid = (self.cluster.wait_leader(timeout=10.0)
+               or self.cluster.node_ids()[0])
+        topo = self.cluster.group_topology(nid)
+        leaders = {gid: self.cluster.group_leader(gid)
+                   for gid in range(self.cfg.lms_groups)}
+        ledger_report = self.ledger.report()
+        return {
+            "n_groups": self.cfg.lms_groups,
+            "routing_map": topo.get("routing_map", {}),
+            "topology": topo.get("groups", {}),
+            "leaders": leaders,
+            # The verdict only DEMANDS a completed handoff when the
+            # event schedule actually planned the live split.
+            "expected_reshard": bool(self.cfg.events),
+            "reshards": ledger_report.get("reshards", []),
+            "acked_by_group": ledger_report.get("acked_by_group", {}),
+            "acked_across_reshard": ledger_report.get(
+                "acked_across_reshard", 0
+            ),
+        }
+
     def _scoring_summary(self) -> Optional[Dict]:
         """Background scoring-tenant evidence from the tutoring fleet's
         merged counters: the bulk-grading night's completion claim
@@ -637,7 +690,7 @@ class SemesterSim:
 
     def _record(self, ops, plan, scheduler, report, node_metrics,
                 traces, wall_s: float, telemetry=None,
-                fleet=None, scoring=None) -> Dict:
+                fleet=None, scoring=None, groups=None) -> Dict:
         snap = self.metrics.snapshot()
         counters = snap.get("counters", {})
         ask = snap_hist(snap, metric.SIM_ASK_LATENCY)
@@ -685,6 +738,11 @@ class SemesterSim:
             # off): the bulk-grading night's jobs/quanta/tokens plus the
             # measured interactive preemption wait behind score quanta.
             "scoring": scoring,
+            # Sharded-control-plane evidence (None for one group): the
+            # final routing map, per-group leaders, and which acked
+            # writes crossed the live split's resharding boundary.
+            "lms_groups": self.cfg.lms_groups,
+            "groups": groups,
             "course_concentration": self.cfg.course_concentration,
             # Measured shared-prefix KV cache hit rate on the tutoring
             # node (None unless the engine runs the radix cache, i.e.

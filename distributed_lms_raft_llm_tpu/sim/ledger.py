@@ -47,6 +47,20 @@ class AckedWrite:
     key: Tuple[str, ...]      # e.g. ("student003", "hw.pdf")
     value: str                # content hash / grade / query text
     acked_at: float           # time.monotonic() when the ack arrived
+    group: Optional[int] = None   # owning Raft group at ack time (sharded)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardMark:
+    """A routing-map flip the workload observed mid-run: every write
+    acked before `at` whose `group` == `src` crossed the resharding
+    boundary, and the end-of-run audit proving it present on the NEW
+    owner is the zero-acked-write-loss evidence for the handoff."""
+    course: str
+    src: int
+    dst: int
+    version: int
+    at: float
 
 
 class WriteLedger:
@@ -55,16 +69,30 @@ class WriteLedger:
         self._writes: List[AckedWrite] = []       # guarded-by: _lock
         self._violations: List[str] = []          # guarded-by: _lock
         self._losses: List[str] = []              # guarded-by: _lock
+        self._reshards: List[ReshardMark] = []    # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- recording
 
-    def record(self, kind: str, key: Tuple[str, ...], value: str = "") -> None:
-        """Call ONLY after the cluster acked the write."""
+    def record(self, kind: str, key: Tuple[str, ...], value: str = "",
+               group: Optional[int] = None) -> None:
+        """Call ONLY after the cluster acked the write. `group` tags the
+        write with the Raft group that owned its subject at ack time (per
+        the routing map the workload routed against), so the audit can
+        show which acked writes crossed a later resharding boundary."""
         w = AckedWrite(kind=kind, key=key, value=value,
-                       acked_at=time.monotonic())
+                       acked_at=time.monotonic(), group=group)
         with self._lock:
             self._writes.append(w)
+
+    def note_reshard(self, course: str, src: int, dst: int,
+                     version: int) -> None:
+        """Mark a completed routing-map flip (group split/merge)."""
+        with self._lock:
+            self._reshards.append(ReshardMark(
+                course=course, src=src, dst=dst, version=version,
+                at=time.monotonic(),
+            ))
 
     def acked_before(self, t0: float, kind: str) -> List[AckedWrite]:
         with self._lock:
@@ -175,8 +203,29 @@ class WriteLedger:
 
     def report(self) -> Dict:
         with self._lock:
-            return {
+            by_group: Dict[str, int] = {}
+            for w in self._writes:
+                if w.group is not None:
+                    label = f"group{w.group}"
+                    by_group[label] = by_group.get(label, 0) + 1
+            crossed = sum(
+                1 for w in self._writes for r in self._reshards
+                if w.group == r.src and w.acked_at < r.at
+            )
+            out = {
                 "acked_writes": len(self._writes),
                 "ryw_violations": list(self._violations),
                 "losses": list(self._losses),
             }
+            if by_group or self._reshards:
+                out["acked_by_group"] = by_group
+                out["reshards"] = [
+                    {"course": r.course, "src": r.src, "dst": r.dst,
+                     "version": r.version}
+                    for r in self._reshards
+                ]
+                # Writes whose owning group changed under them: the
+                # population the final audit certifies as lossless
+                # across the handoff.
+                out["acked_across_reshard"] = crossed
+            return out

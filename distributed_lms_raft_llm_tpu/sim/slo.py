@@ -85,6 +85,9 @@ class SloReport:
     # Background scoring-tenant summary (jobs/quanta/tokens from the
     # tutoring fleet's counters); None when the tenant is disabled.
     scoring: Optional[Dict[str, Any]] = None
+    # Sharded-control-plane summary (routing map, per-group leaders,
+    # reshard evidence); None for a single-group deployment.
+    groups: Optional[Dict[str, Any]] = None
 
     @property
     def ok(self) -> bool:
@@ -104,6 +107,7 @@ class SloReport:
             "continuous": self.continuous,
             "fleet": self.fleet,
             "scoring": self.scoring,
+            "groups": self.groups,
         }
 
 
@@ -357,6 +361,7 @@ def evaluate_slos(
     continuous: Optional[Dict[str, Any]] = None,
     fleet: Optional[Dict[str, Any]] = None,
     scoring: Optional[Dict[str, Any]] = None,
+    groups: Optional[Dict[str, Any]] = None,
 ) -> SloReport:
     """`node_metrics`/`node_health`: node id -> scraped JSON snapshots of
     every node alive at the end of the run; `sim_metrics`: the harness's
@@ -498,6 +503,39 @@ def evaluate_slos(
             ">= 1 bulk job completed, 0 failed",
         )
 
+    if groups is not None:
+        # Sharded-control-plane verdicts ([sim] lms_groups > 1): every
+        # Raft group must end the run with a leader (the per-group
+        # leader-loss drill healed), and when a live split was planned
+        # the routing map must have flipped — the staged handoff ran to
+        # `done`, not just "was attempted". Zero acked-write loss ACROSS
+        # the flip is already pinned by zero_acked_write_loss above: the
+        # ledger tags every write with its owning group and the audit
+        # re-reads the moved keys through the post-flip map.
+        leaderless = sorted(
+            gid for gid, nid in groups.get("leaders", {}).items()
+            if nid is None
+        )
+        check(
+            "groups_routable", not leaderless,
+            f"leaderless groups: {leaderless}" if leaderless
+            else (f"all {groups.get('n_groups', 0)} groups have leaders: "
+                  f"{groups.get('leaders', {})}"),
+            "a leader per Raft group",
+        )
+        if groups.get("expected_reshard"):
+            reshards = groups.get("reshards", [])
+            version = int(
+                groups.get("routing_map", {}).get("version", 1)
+            )
+            check(
+                "reshard_completed", bool(reshards) and version > 1,
+                f"{len(reshards)} reshard(s), map version {version}"
+                + (f", {groups.get('acked_across_reshard', 0)} acked "
+                   "writes crossed the boundary" if reshards else ""),
+                ">= 1 completed handoff, routing map flipped",
+            )
+
     hit_rate = snap_gauge(tutoring_metrics or {},
                           metric.PREFIX_CACHE_HIT_RATE, default=-1.0)
     return SloReport(
@@ -506,4 +544,5 @@ def evaluate_slos(
         continuous=continuous,
         fleet=fleet,
         scoring=scoring,
+        groups=groups,
     )

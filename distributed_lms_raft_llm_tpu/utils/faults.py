@@ -109,15 +109,20 @@ class FaultInjector:
                 self._specs.pop(target, None)
 
     def spec_for(self, target: str) -> Optional[FaultSpec]:
-        """Most-specific spec for `target`, with hierarchical fallback:
-        `tutoring:2` falls back to `tutoring`, then to the `*` wildcard —
-        so per-fleet-member chaos (`tutoring:<i>`) composes with the
-        legacy whole-tier target and one spec can still blanket a node's
-        entire egress."""
+        """Most-specific spec for `target`, with hierarchical fallback
+        walked one `:` segment at a time: `raft:2:4` (group 2's hop to
+        peer 4) falls back to `raft:2` (all of group 2's traffic), then
+        `raft` (every group), then the `*` wildcard — so per-group chaos
+        (`raft:<gid>`) composes with per-peer and whole-tier targets the
+        way `tutoring:<i>`/`tutoring` already do, and one spec can still
+        blanket a node's entire egress."""
         with self._lock:
-            spec = self._specs.get(target)
-            if spec is None and ":" in target:
-                spec = self._specs.get(target.rsplit(":", 1)[0])
+            key = target
+            while True:
+                spec = self._specs.get(key)
+                if spec is not None or ":" not in key:
+                    break
+                key = key.rsplit(":", 1)[0]
             return spec or self._specs.get("*")
 
     def plan(self, target: str) -> FaultPlan:

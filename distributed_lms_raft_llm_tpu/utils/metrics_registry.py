@@ -213,6 +213,43 @@ BREAKER_TRANSITION_COUNTERS: Dict[str, str] = {
     "half_open": TUTORING_BREAKER_HALF_OPEN,
 }
 
+# LMS group router (lms/group_router.py) — course-sharded control plane.
+# Aggregate series only: per-group detail is deliberately served by
+# GET /admin/raft instead of runtime-formatted metric names, which this
+# registry forbids.
+
+ROUTER_GROUP_FORWARDS = counter(
+    "router_group_forwards",
+    "LMS RPCs the router forwarded to another node because that node "
+    "leads the subject's Raft group",
+)
+ROUTER_FANOUT_READS = counter(
+    "router_fanout_reads",
+    "cross-group reads (course materials, unanswered queries) fanned "
+    "out to every group's leader and merged",
+)
+ROUTER_FROZEN_REJECTIONS = counter(
+    "router_frozen_rejections",
+    "writes/reads refused with UNAVAILABLE because the subject was "
+    "frozen or tombstoned mid-reshard (the client retries against the "
+    "flipped routing map; never a silent drop)",
+)
+RESHARD_STEPS = counter(
+    "reshard_steps",
+    "journaled reshard handoff steps persisted to the meta group "
+    "(begin/frozen/installed/committed/done)",
+)
+RESHARD_COMPLETED = counter(
+    "reshard_completed",
+    "reshard handoffs that reached 'done': slice installed on the "
+    "target, map flipped, source copy dropped behind tombstones",
+)
+ROUTING_MAP_VERSION = gauge(
+    "routing_map_version",
+    "version of the replicated course->group routing map this router "
+    "last parsed from the meta group",
+)
+
 # Tutoring node (serving/tutoring_server.py + engine/batcher.py).
 
 LLM_UNAUTHORIZED = counter(

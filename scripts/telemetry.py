@@ -89,6 +89,48 @@ def _fmt(value: Optional[float]) -> str:
     return f"{value:7.2f}"
 
 
+def fetch_groups(endpoints: List[str],
+                 timeout_s: float = 2.0) -> Optional[Dict[str, Any]]:
+    """GET /admin/raft from the first endpoint that answers: the sharded
+    control plane's routing-map version and per-group rows. None when no
+    node serves the endpoint (pre-shard deployments keep the old frame)."""
+    import urllib.error
+    import urllib.request
+
+    for base in endpoints:
+        try:
+            req = urllib.request.Request(f"{base}/admin/raft",
+                                         method="GET")
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+    return None
+
+
+def render_groups(groups: Dict[str, Any], out: Any) -> None:
+    """Per-Raft-group dashboard rows from one node's GET /admin/raft."""
+    rmap = groups.get("routing_map", {})
+    out.write(
+        f"  routing map v{rmap.get('version', '?')}  "
+        f"groups={rmap.get('n_groups', '?')}  "
+        f"courses={len(rmap.get('courses', {}))}\n"
+    )
+    rows = groups.get("groups", {})
+    if not rows:
+        return
+    out.write(f"  {'group':<7} {'leader':>7} {'term':>7} "
+              f"{'applied':>8} {'commit':>8} {'members':>8}\n")
+    for gid in sorted(rows, key=lambda g: int(g)):
+        row = rows[gid]
+        leader = row.get("leader")
+        out.write(
+            f"  {gid:<7} {('-' if leader is None else leader):>7} "
+            f"{row.get('term', 0):>7} {row.get('applied', 0):>8} "
+            f"{row.get('commit', 0):>8} {len(row.get('members', {})):>8}\n"
+        )
+
+
 def render_dashboard(scraper: ClusterScraper, window_s: float,
                      burn: Optional[Dict[str, float]] = None,
                      out: Any = None) -> None:
@@ -406,6 +448,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 scraper, window_s=max(10.0, 2 * interval),
                 burn=_degraded_burn(scraper, windows, degraded_bound),
             )
+            groups = fetch_groups(args.endpoint)
+            if groups is not None:
+                render_groups(groups, sys.stdout)
             if windows_note:
                 sys.stdout.write(f"  {windows_note}\n")
             sys.stdout.flush()

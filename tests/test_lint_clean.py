@@ -186,6 +186,49 @@ def test_pool_literal_timeout_fails_lint():
     )
 
 
+ROUTER = "distributed_lms_raft_llm_tpu/lms/group_router.py"
+
+
+def test_router_literal_timeout_fails_lint():
+    """PR 16 acceptance pin: the group router's leader forwards derive
+    their timeout from the caller's live Deadline budget. Re-hardcoding
+    one (what reverting the sweep would do) must fail deadline-flow —
+    the router is an egress-root module like the tutoring pool."""
+    project = _project_with_patch(ROUTER, (
+        "stub.Register(request, timeout=timeout, "
+        "metadata=trace_metadata(md))",
+        "stub.Register(request, timeout=30, "
+        "metadata=trace_metadata(md))",
+    ))
+    findings = [
+        f for f in DeadlineFlowRule().check_project(project)
+        if f.path == ROUTER
+    ]
+    assert findings, (
+        "a re-hardcoded router forward timeout must fail deadline-flow"
+    )
+
+
+def test_router_metadata_bypass_fails_lint():
+    """PR 16 acceptance pin: the router's cross-node forwards carry the
+    trace context (plus group/hops/deadline metadata) through
+    trace_metadata(). Bypassing the wrapper on one forward must fail
+    trace-propagation."""
+    project = _project_with_patch(ROUTER, (
+        "stub.Register(request, timeout=timeout, "
+        "metadata=trace_metadata(md))",
+        "stub.Register(request, timeout=timeout, metadata=md)",
+    ))
+    findings = [
+        f for f in TracePropagationRule().check_project(project)
+        if f.path == ROUTER and "Register" in f.message
+    ]
+    assert findings, (
+        "a router egress whose metadata bypasses trace_metadata() must "
+        "fail trace-propagation"
+    )
+
+
 def test_unregistered_metric_name_fails_lint():
     project = _project_with_patched_service(
         '"tutoring_degraded"', '"tutoring_degarded"'

@@ -351,6 +351,120 @@ def test_admin_faults_get_reports_campaigns(tmp_path):
         cluster.stop()
 
 
+# ------------------------------------------- sharded control plane (PR 16)
+
+# A second, smaller seeded run with TWO Raft groups: the full fault
+# schedule plus the group drills — a group-1 leader loss and a live
+# course split (group 0 → group 1) under a chaos overlay at the diurnal
+# peak. Every assertion below reads this one run.
+GROUPS_CFG = SimConfig(
+    seed=23, students=8, instructors=2, courses=2,
+    duration_s=12.0, base_rate=6.0, workers=6, llm_budget_s=10.0,
+    tutoring_nodes=1, bulk_scoring=False, lms_groups=2,
+    slo_answer_p95_s=10.0, slo_degraded_rate_max=0.6,
+    slo_tick_stalls_max=50,
+)
+
+GROUPS_WALL_BUDGET_S = 90.0
+
+
+@pytest.fixture(scope="module")
+def groups_run(tmp_path_factory):
+    t0 = time.monotonic()
+    record = SemesterSim(
+        GROUPS_CFG, str(tmp_path_factory.mktemp("sharded"))
+    ).run()
+    return record, time.monotonic() - t0
+
+
+def test_sharded_sim_slos_hold_with_zero_acked_loss(groups_run):
+    """The PR-16 acceptance scenario: a live group split under load
+    (chaos campaign active, diurnal peak) completes with every SLO —
+    including zero acked-write loss — still green."""
+    record, _ = groups_run
+    slos = record["slos"]
+    assert slos["ok"], "SLO failures: " + str({
+        k: v for k, v in slos["checks"].items() if not v["ok"]
+    })
+    assert slos["checks"]["zero_acked_write_loss"]["ok"]
+    assert slos["checks"]["groups_routable"]["ok"]
+    assert slos["checks"]["reshard_completed"]["ok"], (
+        slos["checks"]["reshard_completed"]
+    )
+    assert record["acked_writes"] > 20, "the run must really write"
+
+
+def test_sharded_sim_ran_group_drills(groups_run):
+    """Both group drills executed through the real admin plane: the
+    targeted `raft:<gid>` leader loss recovered by re-election, and the
+    mid-peak split flipped the routing map on every node."""
+    record, _ = groups_run
+    failed = [e for e in record["events"] if not e["ok"]]
+    assert not failed, f"events failed: {failed}"
+    executed = record["events_executed"]
+    assert executed.get("group_leader_loss", 0) >= 1
+    assert executed.get("group_split", 0) >= 1
+    # The classic drills still run alongside the group ones.
+    for kind in ("rolling_restart", "chaos_campaign", "membership_add",
+                 "membership_remove"):
+        assert executed.get(kind, 0) >= 1, f"missing event kind {kind}"
+
+
+def test_sharded_sim_reshard_evidence_in_ledger(groups_run):
+    """The ledger is group-aware: acked writes carry their owning group,
+    the split left a reshard mark, and the end-of-run audit re-read
+    every pre-split write through the POST-flip map (that is what
+    `acked_across_reshard` counts)."""
+    record, _ = groups_run
+    groups = record["groups"]
+    assert groups is not None and groups["n_groups"] == 2
+    assert len(groups["reshards"]) >= 1
+    move = groups["reshards"][0]
+    assert move["src"] != move["dst"]
+    assert set(groups["acked_by_group"]) == {"group0", "group1"}
+    assert groups["acked_across_reshard"] >= 1, (
+        "no acked write predated the split — the drill must run "
+        "mid-workload, not after it"
+    )
+    # The flip bumped the replicated map exactly as many times as there
+    # were completed handoffs.
+    assert groups["routing_map"]["version"] == 1 + len(groups["reshards"])
+
+
+def test_sharded_sim_topology_endpoint_shape(groups_run):
+    """GET /admin/raft (satellite 3): the routing map plus one row per
+    group with members/leader/term/applied/commit — what
+    scripts/telemetry.py renders as per-group dashboard rows."""
+    record, _ = groups_run
+    groups = record["groups"]
+    topo = groups["topology"]
+    assert set(topo) == {"0", "1"}
+    for gid, row in topo.items():
+        assert row["leader"] is not None, f"group {gid} leaderless"
+        assert row["term"] >= 1
+        assert row["commit"] >= row["applied"] >= 0
+        assert len(row["members"]) >= 3
+    assert all(nid is not None for nid in groups["leaders"].values())
+
+
+def test_sharded_sim_bench_record_fields(groups_run):
+    """The BENCH record carries the sharding verdict inputs for replay:
+    group count and the groups block itself."""
+    record, _ = groups_run
+    assert record["lms_groups"] == 2
+    assert record["metric"] == "semester_sim_ask_p95_s"
+    assert record["groups"]["expected_reshard"] is True
+
+
+def test_sharded_sim_wall_budget(groups_run):
+    """CI guard: the sharded tier-1 sim must stay inside its time box."""
+    _, wall = groups_run
+    assert wall < GROUPS_WALL_BUDGET_S, (
+        f"sharded semester sim took {wall:.1f}s (budget "
+        f"{GROUPS_WALL_BUDGET_S}s) — trim the config or demote it to slow"
+    )
+
+
 # ------------------------------------------------------------ tier-2 soak
 
 
