@@ -296,6 +296,13 @@ class GroupsConfig:
 
     count: int = 1          # Raft groups (1 = today's single-group world)
     port_stride: int = 1000  # group gid's Raft port = base + stride * gid
+    secret: str = ""        # shared router HMAC key: signs the x-lms-*
+    #                         control metadata of forwarded legs so a
+    #                         client cannot forge group targeting or
+    #                         forced auth salts/tokens. Every node of a
+    #                         deployment must use the same value; empty
+    #                         (default) disables forgery protection but
+    #                         keeps routers interoperable.
 
     def __post_init__(self) -> None:
         if self.count < 1:

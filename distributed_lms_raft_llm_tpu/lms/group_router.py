@@ -39,6 +39,7 @@ registry deliberately forbids runtime-formatted series.
 from __future__ import annotations
 
 import hashlib
+import hmac
 import json
 import logging
 import os
@@ -79,6 +80,15 @@ USER_METADATA_KEY = "x-lms-user"
 # store identical credentials/sessions.
 AUTH_SALT_METADATA_KEY = "x-lms-auth-salt"
 AUTH_TOKEN_METADATA_KEY = "x-lms-auth-token"
+# Router-to-router HMAC over the x-lms-* control pairs of a forwarded
+# leg. Routers share a deployment secret; clients never see it, so a
+# client cannot target its own writes at a non-home group (x-lms-group)
+# or pin its own KDF salt / session token (x-lms-auth-*) — unsigned or
+# bad-signature control metadata is simply ignored and the RPC routes
+# as client-originated. `x-lms-user` stays an UNSIGNED hint: the client
+# legitimately sends it, and it is routing-advisory only (the inner
+# handlers authenticate the token themselves).
+ROUTER_SIG_METADATA_KEY = "x-lms-router-sig"
 
 MAX_FORWARD_HOPS = 2
 
@@ -86,6 +96,16 @@ MAX_FORWARD_HOPS = 2
 def stable_hash(name: str) -> int:
     """Deterministic cross-process hash (builtin hash() is salted)."""
     return int(hashlib.sha1(name.encode()).hexdigest()[:12], 16)
+
+
+def sign_router_metadata(secret: str, pairs: List[Tuple[str, str]]) -> str:
+    """HMAC-SHA256 vouching that a set of x-lms-* control pairs was
+    minted by a router, not forged by a client. Pairs are canonicalized
+    sorted, so metadata reordering on the wire cannot break the check.
+    A replayed signature can only repeat the identical (idempotent)
+    routing decision it originally authorized."""
+    canon = "\n".join(f"{k}={v}" for k, v in sorted(pairs))
+    return hmac.new(secret.encode(), canon.encode(), hashlib.sha256).hexdigest()
 
 
 # --------------------------------------------------------------------------
@@ -190,11 +210,19 @@ class RouteError(Exception):
 class _InnerContext:
     """Context wrapper for locally-dispatched legs.
 
-    Overrides exactly two things: `invocation_metadata` (to append the
-    router's forced auth metadata) and `abort` (to raise RouteError so a
+    Overrides exactly two things: `invocation_metadata` (to strip the
+    raw wire's x-lms-* pairs and append only the pairs the router
+    minted or signature-verified) and `abort` (to raise RouteError so a
     fan-out can observe one leg's failure without killing the real gRPC
     context). Everything else delegates to the real context.
+
+    `lms_router_leg` marks the context as router-dispatched: the inner
+    servicer's `_forced_auth` only honors x-lms-auth-* metadata behind
+    this mark, so a client dialing a single-group servicer directly
+    cannot pin its own salt or session token.
     """
+
+    lms_router_leg = True
 
     def __init__(self, inner: Any, extra: Optional[List[Tuple[str, str]]] = None) -> None:
         self._inner = inner
@@ -202,7 +230,12 @@ class _InnerContext:
 
     def invocation_metadata(self) -> List[Tuple[str, str]]:
         base = self._inner.invocation_metadata() or ()
-        return [(str(k), str(v)) for k, v in base] + self._extra
+        kept = [
+            (str(k), str(v))
+            for k, v in base
+            if not str(k).startswith("x-lms-")
+        ]
+        return kept + self._extra
 
     async def abort(self, code: grpc.StatusCode, details: str = "") -> None:
         raise RouteError(code, details)
@@ -240,6 +273,7 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
         initial_map: Optional[RoutingMap] = None,
         metrics: Optional[Metrics] = None,
         forward_timeout_s: float = 5.0,
+        router_secret: str = "",
     ) -> None:
         self._nodes = lms_nodes
         self._inner = inner
@@ -249,6 +283,14 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
         self._initial_map = initial_map or RoutingMap.initial(len(lms_nodes))
         self.metrics = metrics or Metrics()
         self._forward_timeout_s = forward_timeout_s
+        # Shared across every router of ONE deployment ([groups] secret;
+        # the sim cluster mints a random one per cluster). Signs the
+        # x-lms-* control pairs of forwarded legs so peers can tell
+        # router-minted metadata from client forgeries. The empty default
+        # keeps ad-hoc boots working (all routers agree on the empty
+        # key) but offers no forgery protection — set a real secret in
+        # any deployment that untrusted clients can reach.
+        self._router_secret = router_secret
         self.hints = GroupLeaderHints()
         self._map_raw: Optional[str] = None
         self._map_cache: RoutingMap = self._initial_map
@@ -295,15 +337,55 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
                 return str(user)
         return _metadata_get(context, USER_METADATA_KEY)
 
+    def _signed_md(self, context: Any) -> Dict[str, str]:
+        """The x-lms-* control pairs of this RPC, honored only when the
+        sending router's HMAC over them verifies. No signature or a bad
+        one → empty dict: the RPC is treated as client-originated and
+        its forged x-lms-group / x-lms-auth-* pairs are ignored."""
+        pairs = [
+            (str(k), str(v))
+            for k, v in (context.invocation_metadata() or ())
+            if str(k).startswith("x-lms-") and str(k) != ROUTER_SIG_METADATA_KEY
+        ]
+        if not pairs:
+            return {}
+        sig = _metadata_get(context, ROUTER_SIG_METADATA_KEY)
+        if sig is None or not hmac.compare_digest(
+            sign_router_metadata(self._router_secret, pairs), sig
+        ):
+            # The bare user hint is a documented client-sent pair; only
+            # count actual control-metadata forgeries.
+            if any(k != USER_METADATA_KEY for k, _ in pairs):
+                self.metrics.inc(series.ROUTER_UNSIGNED_METADATA)
+            return {}
+        return dict(pairs)
+
+    def _relayed_auth_md(
+        self,
+        context: Any,
+        present: Optional[List[Tuple[str, str]]],
+    ) -> List[Tuple[str, str]]:
+        """Signature-verified forced-auth pairs from the wire, minus any
+        the caller is already carrying — so a forwarded Register/Login
+        leg keeps its entry-router salt/token through local dispatch and
+        further hops alike."""
+        signed = self._signed_md(context)
+        have = {k for k, _ in (present or [])}
+        return [
+            (key, signed[key])
+            for key in (AUTH_SALT_METADATA_KEY, AUTH_TOKEN_METADATA_KEY)
+            if key in signed and key not in have
+        ]
+
     def _hops(self, context: Any) -> int:
-        raw = _metadata_get(context, HOPS_METADATA_KEY)
+        raw = self._signed_md(context).get(HOPS_METADATA_KEY)
         try:
             return int(raw) if raw is not None else 0
         except ValueError:
             return 0
 
     def _targeted_group(self, context: Any) -> Optional[int]:
-        raw = _metadata_get(context, GROUP_METADATA_KEY)
+        raw = self._signed_md(context).get(GROUP_METADATA_KEY)
         if raw is None:
             return None
         try:
@@ -358,7 +440,11 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
             if write:
                 self._guard_subject(gid, subject)
             handler = getattr(self._inner[gid], name)
-            response = await handler(request, _InnerContext(context, extra_md))
+            # A forwarded auth leg carries the entry router's forced
+            # salt/token on the wire; re-vouch the verified pairs into
+            # the inner context (which strips all raw x-lms-* metadata).
+            inner_md = (extra_md or []) + self._relayed_auth_md(context, extra_md)
+            response = await handler(request, _InnerContext(context, inner_md))
             if write and subject is not None and node.state.frozen_for(subject):
                 # Freeze committed around our write. The write either
                 # landed pre-freeze (it rides the slice, and the
@@ -433,6 +519,15 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
             md.extend(deadline.to_metadata())
         if extra_md:
             md.extend(extra_md)
+        # Multi-hop auth legs: keep relaying the entry router's verified
+        # salt/token, then sign every x-lms-* control pair so the next
+        # router can tell this leg from a client forgery.
+        md.extend(self._relayed_auth_md(context, md))
+        signable = [(k, v) for k, v in md if k.startswith("x-lms-")]
+        md.append(
+            (ROUTER_SIG_METADATA_KEY,
+             sign_router_metadata(self._router_secret, signable))
+        )
         stub = self._stub(address)
         self.metrics.inc(series.ROUTER_GROUP_FORWARDS)
         try:
@@ -527,8 +622,11 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
         credentials verify wherever a later RPC lands. The router mints
         salt/token once and forces it onto each leg via metadata; the
         meta group's verdict is the client's answer. Any failed
-        secondary leg aborts the whole op — all three are idempotent to
-        retry (first-writer-wins register, re-login, re-logout)."""
+        secondary leg aborts (or heals) the whole op — all three are
+        idempotent to retry (first-writer-wins register, re-login,
+        re-logout), so UNAVAILABLE is always a safe verdict. Silently
+        ignoring a failed leg would let credentials or sessions diverge
+        across groups."""
         targeted = self._targeted_group(context)
         if targeted is not None:
             return await self._execute(targeted, name, request, context)
@@ -547,8 +645,30 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
                 leg = await self._execute(
                     gid, name, request, context, extra_md=extra
                 )
-                if name == "Login" and not getattr(leg, "success", True):
+                if getattr(leg, "success", True):
+                    continue
+                if name == "Login":
                     await self._heal_login_leg(gid, request, context, extra)
+                elif name == "Register":
+                    # The forced-salt register is an idempotent replay on
+                    # a healthy group, so a failed leg means this group
+                    # holds a CONFLICTING record for the name. Surface a
+                    # retryable failure instead of acking divergence.
+                    raise RouteError(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"auth replication of Register to group {gid} "
+                        "failed; retry",
+                    )
+                elif self._nodes[gid].state.user_of_token(request.token) is not None:
+                    # Logout: the only success=False path is an unknown
+                    # token, i.e. the session is already absent there —
+                    # the desired end state. Abort only when this group
+                    # still shows the session (a genuinely diverged leg).
+                    raise RouteError(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"auth replication of Logout to group {gid} "
+                        "failed; retry",
+                    )
         return primary
 
     async def _heal_login_leg(
@@ -587,8 +707,15 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
                 return await self._fanout_read(name, request, context)
             if kind == "token":
                 subject = self._resolve_user(request.token, context)
+                # GetLLMAnswer counts as a write: its degraded fallback
+                # proposes an AskQuery, and a frozen user's fallback
+                # would be no-opped by the applier while the handler
+                # acks "forwarded to an instructor" — an acked write
+                # silently dropped. Guarding it like Post turns the
+                # mid-reshard case into an UNAVAILABLE retry instead.
                 return await self._route_subject(
-                    name, request, context, subject, write=(name == "Post")
+                    name, request, context, subject,
+                    write=(name in ("Post", "GetLLMAnswer")),
                 )
             # kind == "student": explicit subject field on the request
             return await self._route_subject(
@@ -728,6 +855,21 @@ class ReshardCoordinator:
         }
 
     async def reshard(self, course: str, dst: int) -> Dict[str, Any]:
+        # Never clobber an unfinished journal: journaling a fresh 'begin'
+        # over a crashed handoff would orphan its FreezeKeys (no DropKeys
+        # ever follows) and leave those users UNAVAILABLE forever. Roll
+        # the in-flight handoff forward to 'done' first — every step is
+        # idempotent, so this is exactly what a restarted node would do.
+        raw = await self.access.meta_get(RESHARD_JOURNAL_KEY)
+        if raw is not None:
+            prior = json.loads(raw)
+            if prior.get("step") != "done":
+                log.warning(
+                    "reshard %s: rolling forward unfinished handoff %s "
+                    "(step %s) before starting",
+                    course, prior.get("id"), prior.get("step"),
+                )
+                await self._run(prior)
         m = self.access.current_map()
         src = m.courses.get(course)
         if src is None:

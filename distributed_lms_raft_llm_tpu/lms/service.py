@@ -67,7 +67,15 @@ def _forced_auth(context, key: str) -> Optional[str]:
     """Auth material pinned by the group router's replicated-auth fan-out
     (lms/group_router.py): the entry router mints ONE salt/token and
     forces it onto every group's Register/Login leg so credentials and
-    sessions converge across groups. Absent outside multi-group routing."""
+    sessions converge across groups. Absent outside multi-group routing.
+
+    Honored ONLY on router-dispatched legs (the router strips raw
+    x-lms-* wire metadata and re-vouches signature-verified pairs via
+    its _InnerContext, which carries the `lms_router_leg` mark): a
+    client dialing a servicer directly must not be able to pin its own
+    KDF salt or mint its own session token."""
+    if not getattr(context, "lms_router_leg", False):
+        return None
     for k, v in context.invocation_metadata() or ():
         if k == key and v:
             return str(v)

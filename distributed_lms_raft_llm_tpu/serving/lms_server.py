@@ -501,6 +501,7 @@ async def serve_async(args) -> None:
             lms_nodes, inner, lms_node.addresses, args.id,
             initial_map=RoutingMap.initial(args.groups),
             metrics=metrics,
+            router_secret=args.groups_secret or "",
         )
         rpc.add_LMSServicer_to_server(router, server)
     else:
@@ -674,6 +675,12 @@ def main(argv=None) -> None:
                         help="port offset between group Raft planes: "
                              "group g's Raft wire listens on base port "
                              "+ stride*g on every node")
+    parser.add_argument("--groups-secret", default="",
+                        help="shared router HMAC key ([groups] secret): "
+                             "signs forwarded x-lms-* control metadata "
+                             "so clients cannot forge group targeting "
+                             "or auth salts/tokens; must match on every "
+                             "node")
     parser.add_argument("--election-timeout", type=float, default=0.5)
     parser.add_argument("--heartbeat-interval", type=float, default=0.1)
     parser.add_argument("--metrics-period", type=float, default=60.0)
@@ -780,6 +787,7 @@ def main(argv=None) -> None:
             "gate_quant": cfg.gate.quant,
             "groups": cfg.groups.count,
             "groups_port_stride": cfg.groups.port_stride,
+            "groups_secret": cfg.groups.secret,
             "election_timeout": cfg.cluster.election_timeout,
             "heartbeat_interval": cfg.cluster.heartbeat_interval,
             "metrics_period": cfg.cluster.metrics_period,

@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import secrets
 import socket
 import threading
 import time
@@ -199,6 +200,11 @@ class SimCluster:
         self._initial_map = RoutingMap.initial(
             max(1, cfg.lms_groups), self._wgen.courses
         )
+        # One router HMAC key per cluster ([groups] secret in a real
+        # deployment): routers sign forwarded x-lms-* control metadata
+        # with it, so a simulated hostile client cannot forge group
+        # targeting or forced auth salts/tokens.
+        self._router_secret = secrets.token_hex(16)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -804,6 +810,7 @@ class SimCluster:
                 course_of=self._wgen.course_of,
                 initial_map=self._initial_map,
                 metrics=metrics,
+                router_secret=self._router_secret,
             )
             rpc.add_LMSServicer_to_server(router, server)
         else:

@@ -234,6 +234,12 @@ ROUTER_FROZEN_REJECTIONS = counter(
     "frozen or tombstoned mid-reshard (the client retries against the "
     "flipped routing map; never a silent drop)",
 )
+ROUTER_UNSIGNED_METADATA = counter(
+    "router_unsigned_metadata_rejections",
+    "RPCs whose x-lms-* control metadata (group targeting, forced auth "
+    "salt/token) carried no valid router HMAC and was ignored — a "
+    "client forgery or a router-secret mismatch across the deployment",
+)
 RESHARD_STEPS = counter(
     "reshard_steps",
     "journaled reshard handoff steps persisted to the meta group "

@@ -15,17 +15,26 @@ from typing import Any, Dict, List, Optional
 
 import pytest
 
+import grpc
+
 from distributed_lms_raft_llm_tpu.client.client import LMSClient
 from distributed_lms_raft_llm_tpu.config import GroupsConfig, SimConfig
 from distributed_lms_raft_llm_tpu.lms.group_router import (
+    AUTH_SALT_METADATA_KEY,
+    GROUP_METADATA_KEY,
     RESHARD_JOURNAL_KEY,
+    ROUTER_SIG_METADATA_KEY,
     ROUTING_MAP_KEY,
     GroupLeaderHints,
     GroupsAdmin,
     ReshardCoordinator,
+    RoutedLMSServicer,
     RoutingMap,
+    _InnerContext,
+    sign_router_metadata,
     stable_hash,
 )
+from distributed_lms_raft_llm_tpu.lms.service import _forced_auth
 from distributed_lms_raft_llm_tpu.lms.state import LMSState
 from distributed_lms_raft_llm_tpu.utils.faults import FaultInjector
 
@@ -186,6 +195,30 @@ def test_frozen_guard_blocks_source_writes():
     )
     assert "alice" not in state.data["assignments"]
     assert state.frozen_for("alice") == "rs1"
+
+
+def test_installkeys_lifts_moved_tombstones():
+    """A course can reshard BACK to a group it previously left: the
+    install must clear that group's 'moved' tombstones, or the router
+    would reject the returning users' writes forever."""
+    state = LMSState()
+    state.apply("DropKeys", {"users": ["alice", "bob"], "reshard_id": "rs1"})
+    assert set(state.data["moved"]) == {"alice", "bob"}
+    state.apply(
+        "InstallKeys",
+        {
+            "payload": {
+                "users": ["alice"],
+                "assignments": {"alice": [{"filename": "hw", "filepath": "p",
+                                           "grade": None, "text": "t"}]},
+            },
+            "reshard_id": "rs2",
+        },
+    )
+    assert "alice" not in state.data["moved"]
+    # bob did not ride this install; his tombstone stays.
+    assert "bob" in state.data["moved"]
+    assert len(state.data["assignments"]["alice"]) == 1
 
 
 # ----------------------------------------------------- crash-point checker
@@ -401,6 +434,69 @@ def test_reshard_noop_and_validation():
     asyncio.run(run())
 
 
+def test_reshard_round_trip_back_to_origin():
+    """Moving a course away and then back again must leave its users
+    fully writable on the original group: the return leg's InstallKeys
+    lifts the 'moved' tombstones the first leg's DropKeys left behind."""
+
+    async def run():
+        access = _seeded_access()
+        coord = ReshardCoordinator(access, course_of=access.course_of)
+        await coord.reshard("course0", 1)
+        assert set(access.state(0).data["moved"]) == {"alice", "bob"}
+        result = await coord.reshard("course0", 0)
+        assert result["ok"] and result["step"] == "done"
+        # Home again: no tombstones on group 0, slice restored there...
+        src = access.state(0).data
+        assert "alice" not in src.get("moved", {})
+        assert "bob" not in src.get("moved", {})
+        assert len(src["assignments"]["alice"]) == 1
+        assert len(src["queries"]["bob"]) == 1
+        # ...and the return leg tombstoned group 1 instead.
+        assert set(access.state(1).data["moved"]) == {"alice", "bob"}
+        m = access.current_map()
+        assert m.courses["course0"] == 0
+        assert m.version == 3  # two flips
+
+    asyncio.run(run())
+
+
+def test_reshard_rolls_forward_inflight_journal_instead_of_clobbering():
+    """Starting a NEW reshard while a crashed handoff is mid-flight must
+    not overwrite its journal (that would orphan its FreezeKeys and
+    strand the frozen users as UNAVAILABLE forever): the in-flight
+    handoff is rolled forward to 'done' first, then the new one runs."""
+
+    async def run():
+        access = _seeded_access()
+
+        def crash(step: str) -> None:
+            if step == "frozen":
+                raise _Crash(step)
+
+        with pytest.raises(_Crash):
+            await ReshardCoordinator(
+                access, course_of=access.course_of, on_step=crash
+            ).reshard("course0", 1)
+        # course0's users sit frozen on group 0, journal step 'frozen'.
+        assert access.state(0).frozen_for("alice")
+        coord = ReshardCoordinator(access, course_of=access.course_of)
+        result = await coord.reshard("course1", 0)
+        assert result["ok"] and result["step"] == "done"
+        # The crashed handoff completed rather than being clobbered:
+        src = access.state(0).data
+        assert not src.get("frozen")
+        assert set(src["moved"]) >= {"alice", "bob"}
+        assert len(access.state(1).data["assignments"]["alice"]) == 1
+        m = access.current_map()
+        assert m.courses["course0"] == 1
+        # ...and the new handoff landed too, with its own version bump.
+        assert m.courses["course1"] == 0
+        assert m.version == 3
+
+    asyncio.run(run())
+
+
 # -------------------------------------------------------------- admin plane
 
 
@@ -453,3 +549,180 @@ def test_groups_admin_reshard_validates_body():
         assert result["step"] == "done"
 
     asyncio.run(run())
+
+
+# ------------------------------------------------- router metadata trust
+
+
+class _Aborted(Exception):
+    pass
+
+
+class _FakeContext:
+    """Stands in for a grpc.aio context: carries metadata, raises on
+    abort like the real thing."""
+
+    def __init__(self, md: Optional[List] = None) -> None:
+        self._md = list(md or [])
+        self.aborted: Optional[tuple] = None
+
+    def invocation_metadata(self):
+        return list(self._md)
+
+    async def abort(self, code, details=""):
+        self.aborted = (code, details)
+        raise _Aborted(details)
+
+
+class _FakeInner:
+    """Inner per-group servicer double: records (gid, rpc) dispatches
+    and answers success=True unless told otherwise."""
+
+    def __init__(self, gid: int, record: List, responses: Optional[Dict] = None):
+        self._gid = gid
+        self._record = record
+        self._responses = responses or {}
+
+    def __getattr__(self, name: str):
+        async def handler(request, context):
+            self._record.append((self._gid, name))
+            return self._responses.get(name, SimpleNamespace(success=True))
+
+        return handler
+
+
+def _make_router(record: List, responses_by_gid: Optional[Dict] = None,
+                 secret: str = "sekrit"):
+    """Two groups, both locally led, alice's session known on group 0
+    and her course (course0) homed there."""
+    nodes = {
+        0: SimpleNamespace(node=SimpleNamespace(is_leader=True, leader_id=1),
+                           state=LMSState()),
+        1: SimpleNamespace(node=SimpleNamespace(is_leader=True, leader_id=1),
+                           state=LMSState()),
+    }
+    nodes[0].state.data["sessions"]["tok"] = "alice"
+    inner = {
+        gid: _FakeInner(gid, record, (responses_by_gid or {}).get(gid))
+        for gid in nodes
+    }
+    router = RoutedLMSServicer(
+        nodes, inner, {1: "127.0.0.1:1"}, 1,
+        course_of=lambda u: "course0" if u == "alice" else None,
+        initial_map=RoutingMap.initial(2, ["course0", "course1"]),
+        router_secret=secret,
+    )
+    return router, nodes
+
+
+def test_sign_router_metadata_is_order_independent():
+    pairs = [("x-lms-group", "1"), ("x-lms-hops", "1")]
+    assert sign_router_metadata("k", pairs) == sign_router_metadata(
+        "k", list(reversed(pairs))
+    )
+    assert sign_router_metadata("k", pairs) != sign_router_metadata("k2", pairs)
+
+
+def test_router_ignores_forged_group_targeting():
+    """A client-sent x-lms-group with no router signature must not let
+    it target writes at a non-home group (where they would be invisible
+    to home-group reads and reshard slices)."""
+    record: List = []
+    router, _ = _make_router(record)
+    ctx = _FakeContext([(GROUP_METADATA_KEY, "1")])  # forged: unsigned
+    resp = asyncio.run(router.Post(SimpleNamespace(token="tok"), ctx))
+    assert resp.success
+    assert record == [(0, "Post")]  # routed home, not to the forged group
+
+
+def test_router_honors_signed_group_targeting():
+    record: List = []
+    router, _ = _make_router(record)
+    pairs = [(GROUP_METADATA_KEY, "1")]
+    ctx = _FakeContext(
+        pairs + [(ROUTER_SIG_METADATA_KEY,
+                  sign_router_metadata("sekrit", pairs))]
+    )
+    asyncio.run(router.Post(SimpleNamespace(token="tok"), ctx))
+    assert record == [(1, "Post")]
+    # A signature minted under the wrong secret is a forgery again.
+    record2: List = []
+    router2, _ = _make_router(record2)
+    ctx2 = _FakeContext(
+        pairs + [(ROUTER_SIG_METADATA_KEY,
+                  sign_router_metadata("wrong", pairs))]
+    )
+    asyncio.run(router2.Post(SimpleNamespace(token="tok"), ctx2))
+    assert record2 == [(0, "Post")]
+
+
+def test_forced_auth_requires_router_vouched_leg():
+    """A client dialing a servicer directly cannot pin its own KDF salt
+    or session token: x-lms-auth-* is only honored behind the router's
+    _InnerContext mark, which also strips raw wire x-lms-* pairs."""
+    raw = _FakeContext([(AUTH_SALT_METADATA_KEY, "attacker-salt")])
+    assert _forced_auth(raw, AUTH_SALT_METADATA_KEY) is None
+    # Wrapped with no router-vouched extra: the raw pair is stripped.
+    assert _forced_auth(_InnerContext(raw), AUTH_SALT_METADATA_KEY) is None
+    # Router-minted material on the leg IS honored.
+    vouched = _InnerContext(raw, [(AUTH_SALT_METADATA_KEY, "router-salt")])
+    assert _forced_auth(vouched, AUTH_SALT_METADATA_KEY) == "router-salt"
+
+
+def test_router_treats_llm_ask_as_write_for_freeze_guards():
+    """GetLLMAnswer's degraded fallback proposes an AskQuery; for a
+    frozen user that proposal would be silently no-opped while the
+    handler acks 'forwarded to an instructor'. The router must turn the
+    mid-reshard case into an UNAVAILABLE retry instead."""
+    record: List = []
+    router, nodes = _make_router(record)
+    nodes[0].state.apply("FreezeKeys", {"users": ["alice"],
+                                        "reshard_id": "rs"})
+    ctx = _FakeContext()
+    with pytest.raises(_Aborted):
+        asyncio.run(router.GetLLMAnswer(SimpleNamespace(token="tok"), ctx))
+    assert ctx.aborted is not None
+    assert ctx.aborted[0] == grpc.StatusCode.UNAVAILABLE
+    assert record == []  # the handler (and its fallback) never ran
+
+
+def test_auth_fanout_register_leg_failure_is_not_silent():
+    """A secondary Register leg answering success=False means that
+    group holds a conflicting record; acking the primary anyway would
+    let credentials diverge across groups."""
+    record: List = []
+    router, _ = _make_router(
+        record,
+        responses_by_gid={1: {"Register": SimpleNamespace(success=False)}},
+    )
+    req = SimpleNamespace(username="alice", password="pw", role="student")
+    ctx = _FakeContext()
+    with pytest.raises(_Aborted):
+        asyncio.run(router.Register(req, ctx))
+    assert ctx.aborted is not None
+    assert ctx.aborted[0] == grpc.StatusCode.UNAVAILABLE
+
+
+def test_auth_fanout_logout_leg_failure_aborts_only_on_divergence():
+    # success=False with the token unknown on that group: the session
+    # is already absent there — the desired end state — so the op acks.
+    record: List = []
+    router, _ = _make_router(
+        record,
+        responses_by_gid={1: {"Logout": SimpleNamespace(success=False)}},
+    )
+    resp = asyncio.run(router.Logout(SimpleNamespace(token="tok"),
+                                     _FakeContext()))
+    assert resp.success
+    # Same failure while the group still shows the session: diverged.
+    record2: List = []
+    router2, nodes2 = _make_router(
+        record2,
+        responses_by_gid={1: {"Logout": SimpleNamespace(success=False)}},
+    )
+    nodes2[1].state.data["sessions"]["tok"] = "alice"
+    ctx = _FakeContext()
+    with pytest.raises(_Aborted):
+        asyncio.run(router2.Logout(SimpleNamespace(token="tok"), ctx))
+    assert ctx.aborted is not None
+    assert ctx.aborted[0] == grpc.StatusCode.UNAVAILABLE
