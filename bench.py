@@ -133,7 +133,8 @@ def bench_tpu(model: str = "gpt2", tp: int = 1, quant: bool = False,
     }
 
 
-def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
+def bench_paged(model: str = "gpt2", tp: int = 1, ep: int = 1,
+                quant: bool = False,
                 batch: int = BATCH, spec_tokens: int = 0,
                 greedy: bool = False, chunk: int = 16, megastep: int = 1,
                 megastep_max: int = 0, inflight: int = 2,
@@ -178,6 +179,7 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
             length_buckets=tuple(length_buckets or (prompt_len, 64, 128)),
             batch_buckets=tuple(sorted({1, 2, 4, 8, batch})),
             tp=tp,
+            ep=ep,
             quant="int8" if quant else None,
             kv_quant=quant,
             spec_tokens=spec_tokens,
@@ -230,6 +232,20 @@ def bench_paged(model: str = "gpt2", tp: int = 1, quant: bool = False,
         "requests_per_s": len(prompts) / elapsed,
         "ttft_p50_ms": ttft_ms,
         "compile_s": compile_s,
+        # Mesh block (BENCH schema): axis sizes the engine actually built,
+        # the per-chip vs total KV residency the tp sharding buys, and
+        # both tok/s views — total for capacity planning, per-chip for
+        # efficiency comparisons across mesh sizes.
+        "mesh": {
+            "tp": engine.tp,
+            "ep": engine.ep,
+            "dp": int(engine.mesh.shape.get("dp", 1)),
+            "devices": n_chips,
+            "kv_bytes_total": engine.kv_bytes_total,
+            "kv_bytes_per_chip": engine.kv_bytes_per_chip,
+            "tokens_per_sec_total": tps,
+            "tokens_per_sec_per_chip": tps / n_chips,
+        },
         "batch": batch,
         "chunk": chunk,
         "megastep": megastep,
@@ -662,7 +678,14 @@ def main() -> None:
                          "gpt2-moe = 8-expert top-2 small trunk, random "
                          "init)")
     ap.add_argument("--tp", type=int, default=1,
-                    help="tensor-parallel ways (config 4: gpt2-large tp)")
+                    help="tensor-parallel ways (config 4: gpt2-large tp); "
+                         "with --paged the slot KV cache and prefix-cache "
+                         "blocks shard their heads axis over tp too, and "
+                         "the record's mesh block carries per-chip KV "
+                         "bytes")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel ways (MoE models only; shards "
+                         "the expert stacks — paged: requires gpt2-moe)")
     ap.add_argument("--batch", type=int, default=BATCH,
                     help="device batch (BASELINE config is 8)")
     ap.add_argument("--spec-tokens", type=int, default=0,
@@ -746,6 +769,8 @@ def main() -> None:
             args.model = t.model
         if args.tp == 1:
             args.tp = t.tp
+        if args.ep == 1:
+            args.ep = t.ep
     extra = dict(spec_tokens=args.spec_tokens, greedy=args.greedy)
     if args.score_scenario:
         record = bench_score_scenario(
@@ -779,7 +804,7 @@ def main() -> None:
         return
     run = bench_tpu
     if args.paged:
-        run = partial(bench_paged, chunk=args.chunk,
+        run = partial(bench_paged, ep=args.ep, chunk=args.chunk,
                       megastep=args.megastep,
                       megastep_max=args.megastep_max,
                       inflight=args.inflight,
@@ -793,6 +818,8 @@ def main() -> None:
     name = {"gpt2": "gpt2_small"}.get(args.model, args.model.replace("-", "_"))
     if args.tp > 1:
         name += f"_tp{args.tp}"
+    if args.ep > 1:
+        name += f"_ep{args.ep}"
     if args.paged:
         name += "_paged"
     if args.paged and args.megastep > 1:
@@ -819,6 +846,15 @@ def main() -> None:
     }
     if "requests_per_s" in head:
         record["requests_per_s"] = round(head["requests_per_s"], 2)
+    if "mesh" in head:
+        # Per-chip accounting for multi-chip paged serving: axis sizes,
+        # the KV residency the tp sharding splits, both tok/s views.
+        mesh = dict(head["mesh"])
+        mesh["tokens_per_sec_total"] = round(mesh["tokens_per_sec_total"], 2)
+        mesh["tokens_per_sec_per_chip"] = round(
+            mesh["tokens_per_sec_per_chip"], 2
+        )
+        record["mesh"] = mesh
     if "megastep" in head:
         # Paged runs carry the megastep configuration and its target
         # ratio so the recorded trajectory shows host round trips per

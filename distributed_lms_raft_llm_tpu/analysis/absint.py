@@ -24,12 +24,14 @@ Pieces, each consumed by one or more rules in `analysis/rules/`:
   function (resolved through `functools.partial`), and literal
   `donate_argnums` / `static_argnums` — the static mirror of the runtime
   program caches that `utils/guards.compile_count_guard` counts.
-- `SpecEval` + `collect_plane_puts`: evaluates PartitionSpec expressions
-  to a canonical *meaning* (trailing Nones dropped, helper functions like
-  `paged._state_spec` resolved through their returns, call-site argument
-  binding for nested helpers such as `_canon_state.put`), and collects
+- `SpecEval` + `collect_plane_puts` + `collect_plane_tables`: evaluates
+  PartitionSpec expressions to a canonical *meaning* (trailing Nones
+  dropped, helper functions like `paged._plane_spec` resolved through
+  their returns, call-site argument binding for nested helpers such as
+  `_canon_state.put`, literal plane-name strings flowed into spec-table
+  subscripts like `partition.PAGED_PLANE_SPECS[name]`), and collects
   every `jax.device_put` of a named state plane with the spec it lands
-  under.
+  under plus every module-level literal plane->spec table.
 - `DtypeWalker`: forward dtype propagation through a function body
   (constructors, `.astype`, project-local calls, arithmetic promotion),
   with hooks that fire on int8->float upcasts and weak-type promotions.
@@ -317,6 +319,68 @@ def _is_named_sharding_call(expr: ast.expr) -> bool:
     return name == "NamedSharding"
 
 
+def _trailing_name(expr: ast.expr) -> Optional[str]:
+    """'PAGED_PLANE_SPECS' from either the bare Name or a module-qualified
+    `partition.PAGED_PLANE_SPECS` attribute access."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def collect_plane_tables(project: Project) -> Dict[str, Dict[str, object]]:
+    """Every module-level literal spec table in the project: an (optionally
+    annotated) assignment of a Name to a dict whose keys are ALL string
+    constants and whose values are ALL literal P(...)/PartitionSpec(...)
+    calls, each evaluated to its canonical meaning. A dict failing either
+    shape test is not a spec table and is skipped whole — partial tables
+    would let a half-literal dict masquerade as policy. Keyed by the bare
+    table name (`PAGED_PLANE_SPECS`), which is how producer modules
+    subscript it whether imported bare or module-qualified."""
+    tables: Dict[str, Dict[str, object]] = {}
+    for rel, mod in sorted(project.modules.items()):
+        for node in mod.src.tree.body:
+            if isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.value
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            else:
+                continue
+            if not (isinstance(target, ast.Name)
+                    and isinstance(value, ast.Dict) and value.keys):
+                continue
+            entries: Dict[str, object] = {}
+            for k, v in zip(value.keys, value.values):
+                if not (
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Call) and _is_pspec_call(v)
+                ):
+                    entries = {}
+                    break
+                spec = canonical_pspec(v)
+                if isinstance(spec, _Unknown):
+                    entries = {}
+                    break
+                entries[k.value] = spec
+            if entries:
+                tables[target.id] = entries
+    return tables
+
+
+def plane_tables(project: Project) -> Dict[str, Dict[str, object]]:
+    """Memoized collect_plane_tables — SpecEval consults it per Subscript
+    and the pspec-flow rule per project, so scan the module set once."""
+    cached = getattr(project, "_plane_table_cache", None)
+    if cached is None:
+        cached = collect_plane_tables(project)
+        try:
+            project._plane_table_cache = cached
+        except Exception:  # pragma: no cover - frozen project models
+            pass
+    return cached
+
+
 @dataclasses.dataclass
 class Frame:
     """One evaluation scope: explicit bindings (call-site arguments) over
@@ -340,9 +404,30 @@ class SpecEval:
         if depth > _MAX_DEPTH:
             return UNKNOWN
         if isinstance(expr, ast.Constant):
-            return None if expr.value is None else UNKNOWN
+            # Strings flow too: plane NAMES key the spec table
+            # (`partition.PAGED_PLANE_SPECS[name]`), so a literal plane
+            # name bound at a put call site must survive to the Subscript
+            # evaluation below. Everything else non-None stays UNKNOWN.
+            if expr.value is None:
+                return None
+            return expr.value if isinstance(expr.value, str) else UNKNOWN
         if isinstance(expr, ast.Name):
             return self._eval_name(expr.id, frame, depth)
+        if isinstance(expr, ast.Subscript):
+            # `TABLE[name]` against a literal plane-spec table: when the
+            # key evaluates to a known string and the subscripted name
+            # resolves to a collected table (see collect_plane_tables),
+            # the entry's canonical spec IS the value. Anything else —
+            # unknown key, unknown table, missing entry — is UNKNOWN
+            # (missing resolution loses findings, never invents them).
+            key = self.eval(expr.slice, frame, depth + 1)
+            if isinstance(key, str):
+                tname = _trailing_name(expr.value)
+                if tname is not None:
+                    table = plane_tables(self.project).get(tname)
+                    if table is not None:
+                        return table.get(key, UNKNOWN)
+            return UNKNOWN
         if isinstance(expr, ast.IfExp):
             test = self._eval_test(expr.test, frame, depth)
             if test is True:

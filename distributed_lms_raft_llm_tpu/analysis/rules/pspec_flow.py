@@ -1,23 +1,31 @@
-"""pspec-flow: one MEANING per state plane, across every producer.
+"""pspec-flow: one MEANING per named state plane, across every producer.
 
 `canonical-pspec` (PR 3) closed the spelling half of the PR-2 recompile
 incident: `P(None, None)` may no longer be written where `P()` is meant.
-This rule closes the semantic half: a SlotState plane produced under one
-sharding in `_init_state` and respelled under a *different* sharding at
-the dispatch boundary is a real layout divergence — every step program
-would either recompile per producer (when GSPMD tolerates it) or reshard
-per dispatch (when it doesn't), and both spellings can be individually
-canonical, so the lexical rule stays silent.
+This rule closes the semantic half. Since the paged engine went
+mesh-native the policy is a *plane table* — a module-level literal dict
+(`parallel/partition.PAGED_PLANE_SPECS`) mapping each named plane to its
+ONE sharding (KV planes tp-sharded over heads, host planes replicated) —
+so the invariant is two-layered:
+
+- a producer of a plane the table DECLARES must land it under exactly the
+  table's spec: a `device_put` that disagrees is a real layout divergence
+  — every consuming program would either recompile per producer (when
+  GSPMD tolerates it) or reshard per dispatch (when it doesn't), and both
+  spellings can be individually canonical, so the lexical rule stays
+  silent;
+- producers of UNdeclared planes must at least agree with each other
+  (the original pairwise invariant, kept for engine state that predates
+  or sits outside the table).
 
 Mechanics (analysis/absint.py): every `jax.device_put` of a named plane
-(`state.tok`, `state.cache.length`, ...) in the engine modules is
-collected with its spec evaluated to a canonical meaning — helper
-functions (`_state_spec`) resolved through their returns, nested helpers
-(`_canon_state.put`) resolved by binding call-site arguments, `P(...)`
-literals normalized by dropping trailing Nones. Planes whose resolved
-specs disagree get a finding at EVERY producing site, naming the
-conflict; unresolvable specs contribute nothing (missing resolution loses
-findings, never invents them).
+(`state.tok`, `state.cache.k`, ...) in the engine modules is collected
+with its spec evaluated to a canonical meaning — helper functions
+(`_plane_spec`) resolved through their returns, nested helpers
+(`_canon_state.put`) resolved by binding call-site arguments, literal
+plane names flowed into spec-table subscripts, `P(...)` literals
+normalized by dropping trailing Nones. Unresolvable specs contribute
+nothing (missing resolution loses findings, never invents them).
 """
 
 from __future__ import annotations
@@ -33,8 +41,9 @@ from ..project import Project, ProjectRule
 class PSpecFlowRule(ProjectRule):
     name = "pspec-flow"
     description = (
-        "a state plane is device_put under two semantically different "
-        "PartitionSpecs across the engine's producers — the jit caches key "
+        "a state plane is device_put under a sharding that disagrees with "
+        "the plane table (or, for undeclared planes, under two semantically "
+        "different PartitionSpecs across producers) — the jit caches key "
         "per producer and the dispatch boundary pays a recompile or a "
         "reshard (the PR-2 class, beyond spelling)"
     )
@@ -46,7 +55,17 @@ class PSpecFlowRule(ProjectRule):
 
     def check_project(self, project: Project) -> List[Finding]:
         puts = absint.collect_plane_puts(project, self.watch_prefixes)
+        # plane -> (declaring table name, canonical spec). Tables are
+        # policy wherever they live (the real one is in parallel/, outside
+        # the watched producer modules).
+        declared: Dict[str, Tuple[str, str]] = {}
+        for tname, table in sorted(absint.plane_tables(project).items()):
+            for plane, spec in table.items():
+                if isinstance(spec, str):
+                    declared.setdefault(plane, (tname, spec))
         by_plane: Dict[str, List[Tuple[absint.PlanePut, str]]] = {}
+        findings: List[Finding] = []
+        seen = set()
         for put in puts:
             src = project.sources.get(put.rel)
             if src is not None and src.suppressed(self.name, put.line):
@@ -54,10 +73,29 @@ class PSpecFlowRule(ProjectRule):
                 # reshard): it neither reports nor counts as a conflicting
                 # producer against the plane's remaining sites.
                 continue
-            if isinstance(put.spec, str):
+            if not isinstance(put.spec, str):
+                continue
+            decl = declared.get(put.plane)
+            if decl is None:
                 by_plane.setdefault(put.plane, []).append((put, put.spec))
-        findings: List[Finding] = []
-        seen = set()
+                continue
+            tname, want = decl
+            if put.spec == want:
+                continue
+            key = (put.rel, put.line, put.plane)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                rule=self.name, path=put.rel, line=put.line,
+                message=(
+                    f"state plane '{put.plane}' is device_put under "
+                    f"{put.spec}, but the plane table {tname} declares "
+                    f"{want} — every producer must land a named plane "
+                    f"under the table's ONE sharding so all programs "
+                    f"share one jit-cache key (see paged._plane_spec)"
+                ),
+            ))
         for plane, sites in sorted(by_plane.items()):
             specs = sorted({spec for _, spec in sites})
             if len(specs) <= 1:
@@ -77,7 +115,7 @@ class PSpecFlowRule(ProjectRule):
                         f"{len(specs)} different shardings "
                         f"({', '.join(specs)}); this site uses {spec} — "
                         "pick ONE spec per plane so every producer shares "
-                        "one jit-cache key (see paged._state_spec)"
+                        "one jit-cache key (see paged._plane_spec)"
                     ),
                 ))
         return findings

@@ -634,6 +634,18 @@ class PagedQueue:
                         self.metrics.set_gauge("megastep_k", float(mk))
                     self.metrics.set_gauge("serving_queue_depth",
                                            float(self.waiting))
+                    # Multi-chip paged serving: the mesh's tp ways and
+                    # the per-chip KV residency the heads-axis sharding
+                    # buys (tracks cache growth/idle shrink live).
+                    kvb = getattr(self.engine, "kv_bytes_per_chip", None)
+                    if kvb is not None:
+                        self.metrics.set_gauge(
+                            "serving_tp",
+                            float(getattr(self.engine, "tp", 1)),
+                        )
+                        self.metrics.set_gauge(
+                            "serving_kv_bytes_per_chip", float(kvb)
+                        )
                     pop_ds = getattr(self.engine, "pop_dispatch_stats",
                                      None)
                     if pop_ds is not None:

@@ -173,32 +173,33 @@ class _Request:
     live: bool = True
 
 
-def _state_spec(x: jax.Array) -> jax.sharding.PartitionSpec:
-    """The canonical replicated-spec SPELLING for a SlotState plane: `P()`
-    at every rank (trailing Nones dropped — the same canonical form the
-    `canonical-pspec` lint rule enforces on source literals).
+def _plane_spec(name: str) -> jax.sharding.PartitionSpec:
+    """The ONE semantic sharding for a named SlotState/KVBlock plane,
+    resolved from the plane table (`parallel/partition.PAGED_PLANE_SPECS`).
 
-    Different producers of the same SlotState leaf (install's scatter,
-    grow's pad, the step scan, reap's eager active-kill) let GSPMD pick
-    spelling-different specs for the same replicated layout — `P()` vs
-    `P(None, None)` — and the pjit cache keys on the spelling, so the
-    step program silently compiled once per PRODUCER per width (warmup's
-    compile did not cover the live install->step handoff, leaving a
-    hidden first-request XLA compile per width in production). The
-    engine therefore respells the host-state planes to one canonical
-    spec at every step-dispatch boundary (`_canon_state` — a zero-copy
-    Array rewrap), making each (S, k, width) step program compile
-    exactly once: guarded by tests/test_paged_spec.py. The spelling must
-    match what the compiled programs themselves emit, which follows the
-    partition rules' spelling (parallel/partition.py, canonical since
-    the canonical-pspec sweep) — with everything agreeing on `P()`, the
-    steady state rewraps nothing. The KV cache k/v planes are never
-    touched: their sharding belongs to the partitioner (tp meshes shard
-    the heads axis), and a device_put against a non-equivalent sharding
-    would be a real reshard, not a rewrap.
+    Replaces the all-replicated `_state_spec` contract: the KV planes
+    (cache.k/v and the int8-KV scales) shard their heads axis over the
+    tp mesh axis, so the slot KV working set — 47% of the round-5
+    decode step is its attention reads — splits across chips instead of
+    replicating onto every one; the genuinely-replicated host planes
+    keep canonical `P()`.
+
+    The SPELLING discipline survives from the PR-2 incident: different
+    producers of the same plane (install's scatter, grow's pad, the
+    step scan, reap's eager active-kill) would otherwise let GSPMD pick
+    spelling-different specs for one layout — `P()` vs `P(None, None)`
+    — and the pjit cache keys on the spelling, so a program silently
+    compiled once per PRODUCER per width. The engine therefore respells
+    every plane to its table spec at every dispatch boundary
+    (`_canon_state` / `_canon_block` — zero-copy Array rewraps against
+    an equivalent sharding), making each (mesh, S, k, width) program
+    compile exactly once: guarded by tests/test_paged_spec.py and
+    tests/test_paged_sharded.py. The `pspec-flow` lint rule checks
+    every producer's resolved spec against the table, so a producer
+    that disagrees with the plane table fails lint before it can key a
+    second compile.
     """
-    del x  # replicated at any rank spells the same way
-    return jax.sharding.PartitionSpec()
+    return partition.PAGED_PLANE_SPECS[name]
 
 
 def _prefill_program(params, ids, true_len, rng, *, cfg, sampling, model):
@@ -973,9 +974,20 @@ class PagedEngine:
                 "sp applies to TutoringEngine.score's ring-attention path; "
                 "the paged engine has no full-sequence forward to shard"
             )
+        # The paged KV plane table splits the heads axis evenly across tp
+        # shards (partition.PAGED_PLANE_SPECS) — reject non-divisor tp
+        # ways up front with the supported ladder, before any device work.
+        # GQA models shard KV heads (the plane axis); dense models' KV
+        # head count is their head count.
+        partition.validate_tp_heads(
+            getattr(self.cfg, "num_kv_heads", None) or self.cfg.num_heads,
+            config.tp, config.model,
+        )
         self.mesh = mesh_lib.make_mesh(
             {"tp": config.tp, "ep": config.ep, "dp": -1}, devices=devices
         )
+        self.tp = int(self.mesh.shape.get("tp", 1))
+        self.ep = int(self.mesh.shape.get("ep", 1))
         self.tokenizer = tok_lib.load_gpt2_tokenizer(
             config.vocab_path, config.merges_path, config.tokenizer_json
         )
@@ -1331,6 +1343,25 @@ class PagedEngine:
         out, self._queue_waits = self._queue_waits, {}
         return out
 
+    @property
+    def kv_bytes_total(self) -> int:
+        """Logical bytes of the live slot KV working set (k/v plus the
+        int8-KV scale planes when quantized), at the cache's current
+        width. Grows with `_grow` and shrinks on idle rebuild."""
+        c = self.state.cache
+        return sum(
+            int(x.nbytes) for x in (c.k, c.v, c.ks, c.vs) if x is not None
+        )
+
+    @property
+    def kv_bytes_per_chip(self) -> int:
+        """HBM the slot KV working set costs on EACH chip: the KV planes
+        shard their heads axis over tp (partition.PAGED_PLANE_SPECS), so
+        per-chip residency is total/tp — the number the bench record's
+        `mesh` block and the `serving_kv_bytes_per_chip` gauge report,
+        and the resource multi-chip paged serving exists to split."""
+        return self.kv_bytes_total // max(1, self.tp)
+
     def _init_state(self, width: Optional[int] = None) -> SlotState:
         cache = self.family.init_cache(
             self.cfg, self.slots, width or self.widths[0],
@@ -1354,32 +1385,36 @@ class PagedEngine:
             stage_seq=jnp.zeros((self.slots,), jnp.int32),
             stage_rng=jnp.zeros((self.slots,) + key_shape, jnp.uint32),
         )
-        # Replicated mesh sharding from birth, in the canonical spelling:
-        # raw single-device arrays would key the jit caches differently
-        # than the programs' own (pinned) outputs, so the first
-        # install/step after a rebuild would silently recompile (see
-        # _state_spec). Cache k/v planes take the rank-agnostic `P()`
-        # spelling (what install/step donation-aliasing propagates);
-        # the host-state planes take their _state_spec spelling.
-        def put(x, spec=None):
+        # Plane-table mesh shardings from birth, in the canonical
+        # spelling: raw single-device arrays would key the jit caches
+        # differently than the programs' own (pinned) outputs, so the
+        # first install/step after a rebuild would silently recompile
+        # (see _plane_spec). KV planes are born tp-sharded over their
+        # heads axis; host-state planes replicated.
+        def put(x, name):
             return jax.device_put(x, jax.sharding.NamedSharding(
-                self.mesh, spec if spec is not None else _state_spec(x)
+                self.mesh, _plane_spec(name)
             ))
 
-        rep = jax.sharding.PartitionSpec()
         return state._replace(
-            cache=jax.tree_util.tree_map(
-                lambda x: put(x, rep), state.cache._replace(length=None)
-            )._replace(length=put(state.cache.length)),
-            tok=put(state.tok),
-            active=put(state.active),
-            seen=put(state.seen),
-            transcript=put(state.transcript),
-            staged=put(state.staged),
-            stage_cursor=put(state.stage_cursor),
-            stage_len=put(state.stage_len),
-            stage_seq=put(state.stage_seq),
-            stage_rng=put(state.stage_rng),
+            cache=state.cache._replace(
+                k=put(state.cache.k, "cache.k"),
+                v=put(state.cache.v, "cache.v"),
+                ks=(None if state.cache.ks is None
+                    else put(state.cache.ks, "cache.ks")),
+                vs=(None if state.cache.vs is None
+                    else put(state.cache.vs, "cache.vs")),
+                length=put(state.cache.length, "cache.length"),
+            ),
+            tok=put(state.tok, "tok"),
+            active=put(state.active, "active"),
+            seen=put(state.seen, "seen"),
+            transcript=put(state.transcript, "transcript"),
+            staged=put(state.staged, "staged"),
+            stage_cursor=put(state.stage_cursor, "stage_cursor"),
+            stage_len=put(state.stage_len, "stage_len"),
+            stage_seq=put(state.stage_seq, "stage_seq"),
+            stage_rng=put(state.stage_rng, "stage_rng"),
         )
 
     # ------------------------------------------------------------ host API
@@ -1445,6 +1480,10 @@ class PagedEngine:
                     continue  # a prompt this long can't run at this width
                 ids = np.full((1, t), self.tokenizer.pad_id, np.int32)
                 self._rng, rng = jax.random.split(self._rng)
+                # Canon before the admission dispatch exactly as the live
+                # paths do (_admit/_stage_admissions) so warmup and live
+                # traffic key the stage/install programs identically.
+                self.state = self._canon_state(self.state)
                 if self.fused:
                     with self.mesh:
                         self.state = self._stage(
@@ -1483,12 +1522,15 @@ class PagedEngine:
                 ):
                     # Fused shared-prefix programs per width: publish
                     # slices blocks straight out of the live state,
-                    # staging splices them straight back in.
+                    # staging splices them straight back in. Canon first
+                    # — the live path (_publish_staged/_stage_admissions)
+                    # exports and splices from a canonical state.
+                    self.state = self._canon_state(self.state)
                     with self.mesh:
-                        blk = self._export_block(
+                        blk = self._canon_block(self._export_block(
                             self.state.cache, jnp.asarray(0, jnp.int32),
                             jnp.asarray(0, jnp.int32),
-                        )
+                        ))
                         self.state = self._stage_block(
                             self.state, blk, jnp.asarray(0, jnp.int32),
                             jnp.asarray(0, jnp.int32),
@@ -1539,8 +1581,10 @@ class PagedEngine:
                         self.params, jnp.asarray(ids),
                         jnp.asarray(1, jnp.int32), rng,
                     )
-                    blk = self._export_block(c1, jnp.asarray(0, jnp.int32),
-                                             jnp.asarray(0, jnp.int32))
+                    blk = self._canon_block(self._export_block(
+                        c1, jnp.asarray(0, jnp.int32),
+                        jnp.asarray(0, jnp.int32),
+                    ))
                 for s in buckets:
                     if s > t - blk_t:
                         continue
@@ -1724,6 +1768,12 @@ class PagedEngine:
                 continue
             req, bucket, w_req, ids = self._pop_next()
             self._rng, rng = jax.random.split(self._rng)
+            # Canon before the admission dispatches for the same reason
+            # step() canons: grow/install input shardings must match the
+            # warmed programs' keys whatever spelling the previous
+            # program's outputs propagated (zero-copy when already
+            # canonical — the steady state).
+            self.state = self._canon_state(self.state)
             with self.mesh:
                 self._grow_if_needed(w_req)
                 c1, first, seen_row = self._run_prefill(
@@ -1786,6 +1836,10 @@ class PagedEngine:
                 self._prefix_hits[req.rid] = cursor0
                 self._shed_oldest(self._prefix_hits)
                 self._staged_prompts[req.rid] = list(req.tokens)
+            # Same canon-before-dispatch discipline as _admit: the
+            # grow/stage_block/stage programs key on the warmed input
+            # shardings.
+            self.state = self._canon_state(self.state)
             with self.mesh:
                 self._grow_if_needed(w_req)
                 if cursor0:
@@ -1821,17 +1875,26 @@ class PagedEngine:
 
     def _fresh_prefill_cache(self, width: int) -> KVCache:
         """A zeroed single-slot prompt cache for the block splice, born
-        replicated in the canonical spelling (same reasoning as
-        _init_state: raw single-device arrays would key the splice and
-        partial-prefill programs differently than warmup's)."""
+        under the plane table's shardings (same reasoning as _init_state:
+        raw single-device arrays would key the splice and partial-prefill
+        programs differently than warmup's). Its KV planes use the bare
+        plane names — the single-slot [L, 1, Hkv, T, Dh] layout keeps
+        heads at axis 2, so they share the slot cache's tp spec."""
         cache = self.family.init_cache(
             self.cfg, 1, width, dtype=self.cfg.dtype
         )
-        rep = jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec()
-        )
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, rep), cache
+
+        def put(x, name):
+            return jax.device_put(x, jax.sharding.NamedSharding(
+                self.mesh, _plane_spec(name)
+            ))
+
+        return cache._replace(
+            k=put(cache.k, "k"),
+            v=put(cache.v, "v"),
+            ks=None if cache.ks is None else put(cache.ks, "ks"),
+            vs=None if cache.vs is None else put(cache.vs, "vs"),
+            length=put(cache.length, "length"),
         )
 
     def _run_prefill(self, req: _Request, bucket: int, ids: np.ndarray,
@@ -1910,10 +1973,10 @@ class PagedEngine:
         t0, t0u = time.monotonic(), time.time()
 
         def make_block(i: int) -> KVBlock:
-            return self._export_block(
+            return self._canon_block(self._export_block(
                 c1, jnp.asarray(i * blk_t, jnp.int32),
                 jnp.asarray(0, jnp.int32),
-            )
+            ))
 
         added = pc.insert(
             req.tokens[: (req.prompt_len // blk_t) * blk_t], make_block
@@ -1938,16 +2001,20 @@ class PagedEngine:
         blk_t = pc.block_tokens
         t0, t0u = time.monotonic(), time.time()
         slot_ix = jnp.asarray(slot, jnp.int32)
+        # Export from a canonical state: the flip-reap hands us a raw
+        # megastep output, but warmup compiled `_export_block` against
+        # the canonical cache shardings (zero-copy when they agree).
+        self.state = self._canon_state(self.state)
 
         def make_block(i: int) -> KVBlock:
             # Under the mesh context like every other dispatch: the jit
             # cache keys on the ambient mesh, and warmup compiled these
             # programs under it.
             with self.mesh:
-                return self._export_block(
+                return self._canon_block(self._export_block(
                     self.state.cache, jnp.asarray(i * blk_t, jnp.int32),
                     slot_ix,
-                )
+                ))
 
         added = pc.insert(
             tokens[: (req.prompt_len // blk_t) * blk_t], make_block
@@ -2018,27 +2085,59 @@ class PagedEngine:
         return max(0, chunks - debt)
 
     def _canon_state(self, state: SlotState) -> SlotState:
-        """Respell the host-state planes' replicated shardings to the one
-        canonical spec before a step dispatch (see _state_spec). A
-        device_put against an equivalent sharding is a zero-copy Array
-        rewrap (same buffer), so the steady state — planes already
-        canonical — costs five equality checks and nothing else."""
+        """Respell every plane's sharding to its plane-table spec before
+        a dispatch (see _plane_spec) — the KV planes to their tp heads
+        sharding, the host planes to replicated. A device_put against an
+        equivalent sharding is a zero-copy Array rewrap (same buffers),
+        so the steady state — planes already canonical — costs the
+        equality checks and nothing else; only a program that emitted a
+        genuinely different layout would pay a real reshard, and the
+        compile-count guards would surface it as a cache miss first."""
 
-        def put(x):
-            sh = jax.sharding.NamedSharding(self.mesh, _state_spec(x))
+        def put(x, name):
+            sh = jax.sharding.NamedSharding(self.mesh, _plane_spec(name))
             return x if x.sharding == sh else jax.device_put(x, sh)
 
         return state._replace(
-            tok=put(state.tok),
-            active=put(state.active),
-            seen=put(state.seen),
-            transcript=put(state.transcript),
-            staged=put(state.staged),
-            stage_cursor=put(state.stage_cursor),
-            stage_len=put(state.stage_len),
-            stage_seq=put(state.stage_seq),
-            stage_rng=put(state.stage_rng),
-            cache=state.cache._replace(length=put(state.cache.length)),
+            tok=put(state.tok, "tok"),
+            active=put(state.active, "active"),
+            seen=put(state.seen, "seen"),
+            transcript=put(state.transcript, "transcript"),
+            staged=put(state.staged, "staged"),
+            stage_cursor=put(state.stage_cursor, "stage_cursor"),
+            stage_len=put(state.stage_len, "stage_len"),
+            stage_seq=put(state.stage_seq, "stage_seq"),
+            stage_rng=put(state.stage_rng, "stage_rng"),
+            cache=state.cache._replace(
+                k=put(state.cache.k, "cache.k"),
+                v=put(state.cache.v, "cache.v"),
+                ks=(None if state.cache.ks is None
+                    else put(state.cache.ks, "cache.ks")),
+                vs=(None if state.cache.vs is None
+                    else put(state.cache.vs, "cache.vs")),
+                length=put(state.cache.length, "cache.length"),
+            ),
+        )
+
+    def _canon_block(self, blk: KVBlock) -> KVBlock:
+        """Respell an exported prefix block's planes to the plane-table
+        KV sharding before it enters the radix tree, so every cached
+        block is a per-shard device-resident run under ONE sharding: a
+        later hit splices tp-sharded blocks straight into the (equally
+        sharded) live pages without a gather, and every `_load_block`/
+        `_stage_block` dispatch sees one canonical block sharding (one
+        jit-cache key). Zero-copy when the export already propagated the
+        table spec — the steady state."""
+
+        def put(x, name):
+            sh = jax.sharding.NamedSharding(self.mesh, _plane_spec(name))
+            return x if x.sharding == sh else jax.device_put(x, sh)
+
+        return blk._replace(
+            k=put(blk.k, "k"),
+            v=put(blk.v, "v"),
+            ks=None if blk.ks is None else put(blk.ks, "ks"),
+            vs=None if blk.vs is None else put(blk.vs, "vs"),
         )
 
     def step(self) -> List[Tuple[int, str]]:

@@ -38,7 +38,7 @@ Rules = Sequence[Tuple[str, PartitionSpec]]
 # P(None, "tp") not P(None, "tp", None)) — PartitionSpec pads with None,
 # and one canonical spelling per layout keeps spelling-keyed jit caches
 # from silently recompiling (the canonical-pspec lint rule enforces this;
-# see engine/paged._state_spec for the incident).
+# see engine/paged._plane_spec for the incident).
 GPT2_RULES: List[Tuple[str, PartitionSpec]] = [
     (r"wte(/q)?$", P("tp")),       # vocab-sharded embedding
     (r"wte/s$", P("tp")),
@@ -107,15 +107,93 @@ MOE_RULES: List[Tuple[str, PartitionSpec]] = [
 ] + GPT2_RULES
 
 # Rule set per model-family name (models/registry.py ModelFamily.name).
-# (KV-cache sharding — [L, B, Hkv, T, Dh]: batch over dp, heads over tp —
-# is derived by jit's sharding propagation from the param/batch specs; no
-# hand-placed constant needed.)
+# (The bucketed engine's KV-cache sharding — [L, B, Hkv, T, Dh]: batch
+# over dp, heads over tp — is derived by jit's sharding propagation from
+# the param/batch specs. The PAGED engine's slot-state planes are the
+# exception: they cross program boundaries as explicit host-held arrays,
+# so their shardings are pinned by the plane table below instead of
+# re-derived per program.)
 RULES_FOR = {
     "gpt2": GPT2_RULES,
     "llama": LLAMA_RULES,
     "bert": BERT_RULES,
     "gpt2_moe": MOE_RULES,
 }
+
+# ---------------------------------------------- paged state plane table
+#
+# The paged engine's per-plane sharding policy, keyed by PLANE NAME (the
+# attribute chain past the state/cache root — the same key
+# `analysis/absint.collect_plane_puts` derives from producer call sites,
+# so the `pspec-flow` lint rule can check every producer against this
+# table). ONE semantic sharding per named plane across all producers:
+# `_init_state`'s birth puts, `_canon_state`'s dispatch-boundary
+# respells, `_fresh_prefill_cache`, and the prefix-cache block
+# canonicalization all resolve specs HERE and nowhere else.
+#
+# KV planes shard their heads axis over tp: slot cache k/v are
+# [L, S, Hkv, T, Dh] and the int8-KV scale planes ks/vs are
+# [L, S, Hkv, T] — heads is axis 2 in both — so one spec spelling,
+# P(None, None, "tp"), serves the pair; the prefix tree's immutable
+# KVBlock runs ([L, 1, H, B, Dh] / [L, 1, H, B]) share the layout and
+# the spec, making a radix hit splice tp-sharded blocks without a
+# gather. Host-state planes (positions, masks, transcripts, staged
+# cursors, rng keys) are genuinely replicated and keep the canonical
+# `P()` spelling — the PR-2 recompile incident's fix, now per plane.
+# MoE expert planes are PARAMS (MOE_RULES shards their expert axis over
+# ep above); no slot-state plane carries an expert axis, so `ep` does
+# not appear here — state planes replicate over ep exactly like dp.
+#
+# On a tp=1 mesh P(None, None, "tp") degrades to replication (the
+# shard_tree doctrine: axes of size 1 are harmless), so one table
+# serves every mesh.
+PAGED_PLANE_SPECS: Dict[str, PartitionSpec] = {
+    # SlotState.cache planes (engine/paged.SlotState).
+    "cache.k": P(None, None, "tp"),
+    "cache.v": P(None, None, "tp"),
+    "cache.ks": P(None, None, "tp"),
+    "cache.vs": P(None, None, "tp"),
+    "cache.length": P(),
+    # Bare KVCache / prefix KVBlock planes (single-slot prefill caches
+    # and the radix tree's block runs share the heads-at-axis-2 layout).
+    "k": P(None, None, "tp"),
+    "v": P(None, None, "tp"),
+    "ks": P(None, None, "tp"),
+    "vs": P(None, None, "tp"),
+    "length": P(),
+    # Host-state planes: replicated, canonical spelling.
+    "tok": P(),
+    "active": P(),
+    "seen": P(),
+    "transcript": P(),
+    "staged": P(),
+    "stage_cursor": P(),
+    "stage_len": P(),
+    "stage_seq": P(),
+    "stage_rng": P(),
+}
+
+
+def supported_tp(num_kv_heads: int) -> List[int]:
+    """The tp ways that shard `num_kv_heads` KV heads evenly: the
+    ascending divisors. The paged plane table splits the heads axis
+    across tp shards, so any other way would leave ragged head shards
+    (gpt2-large's 20 heads admit [1, 2, 4, 5, 10, 20] — not 8)."""
+    return [d for d in range(1, num_kv_heads + 1) if num_kv_heads % d == 0]
+
+
+def validate_tp_heads(num_kv_heads: int, tp: int, model: str) -> None:
+    """Reject a tp that does not divide the KV head count — loudly, with
+    the exact supported divisors, instead of padding heads (a padded
+    head's KV would cost real HBM and attention bandwidth on every
+    shard, the resource tp exists to split)."""
+    if tp > 1 and num_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} does not divide {model!r}'s {num_kv_heads} KV "
+            f"heads; the paged KV planes shard the heads axis evenly — "
+            f"supported tp ways for this model: "
+            f"{supported_tp(num_kv_heads)}"
+        )
 
 
 def tree_paths(tree: Any) -> List[str]:

@@ -375,6 +375,20 @@ SERVING_QUEUE_DEPTH = gauge(
     "round — queue growth at flat tokens/s is the saturation signal "
     "the capacity model and autoscaler watch",
 )
+SERVING_TP = gauge(
+    "serving_tp",
+    "tensor-parallel ways of the serving engine's mesh — the factor the "
+    "paged KV planes shard their heads axis by (partition."
+    "PAGED_PLANE_SPECS), joining per-chip gauges back to the mesh they "
+    "were measured on",
+)
+SERVING_KV_BYTES_PER_CHIP = gauge(
+    "serving_kv_bytes_per_chip",
+    "HBM the paged slot KV working set occupies on EACH chip at the "
+    "current cache width (total KV bytes / tp — the heads-axis sharding "
+    "splits the planes evenly) — the per-node residency ceiling "
+    "multi-chip paged serving raises to chip-count x HBM",
+)
 
 # Background bulk-scoring tenant (engine/scoring.py + engine/batcher.py):
 # idle-lane harvest — preemptible score quanta co-scheduled behind

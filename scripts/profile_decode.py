@@ -45,7 +45,7 @@ sys.path.insert(0, REPO)
 
 
 def build_engine(batch: int, quant: bool, spec_tokens: int = 0,
-                 greedy: bool = False):
+                 greedy: bool = False, tp: int = 1, ep: int = 1):
     from distributed_lms_raft_llm_tpu.engine import (
         EngineConfig, SamplingParams, TutoringEngine,
     )
@@ -64,6 +64,8 @@ def build_engine(batch: int, quant: bool, spec_tokens: int = 0,
         spec_tokens=spec_tokens,
         batch_buckets=(batch,),
         length_buckets=(64,),
+        tp=tp,
+        ep=ep,
     )
     return TutoringEngine(cfg)
 
@@ -208,6 +210,8 @@ def profile_megastep(args) -> None:
         spec_tokens=args.spec_tokens,
         length_buckets=(16,) if tiny else (64,),
         batch_buckets=(args.batch,),
+        tp=args.tp,
+        ep=args.ep,
         **paths,
     )
     def run(megastep: int) -> dict:
@@ -306,6 +310,13 @@ def main() -> None:
                          "dispatch counts are model-independent)")
     ap.add_argument("--chunk", type=int, default=16,
                     help="paged device chunk size (dispatch-gap mode)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel ways; the paged engine shards "
+                         "its slot KV cache heads axis over tp too, so a "
+                         "tp>1 dispatch-gap profile measures the sharded "
+                         "step programs")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel ways (MoE models only)")
     args = ap.parse_args()
 
     if args.megastep:
@@ -317,7 +328,7 @@ def main() -> None:
 
     eng = build_engine(args.batch, quant=not args.bf16,
                        spec_tokens=args.spec_tokens,
-                       greedy=args.greedy)
+                       greedy=args.greedy, tp=args.tp, ep=args.ep)
     if args.spec_tokens:
         # A REAL prompt: an all-zeros one is 64 repeated tokens, which
         # prompt-lookup drafting predicts near-perfectly — the profile

@@ -1,0 +1,42 @@
+"""Plane-table fixture producers: every device_put of a DECLARED plane
+must land it under exactly the table's spec.
+
+Cases: a name-keyed producer resolving through the table subscript
+(silent — the real `_init_state`/`_canon_state` shape), a producer
+disagreeing with the table (the reversion pin: re-introducing a
+replicated put of a tp-sharded KV plane must fail lint), and a
+suppressed disagreement (sanctioned one-off gather)."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import partition
+
+
+def _plane_spec(name):
+    return partition.PLANE_SPECS[name]
+
+
+def init_state(mesh, state):
+    def put(x, name):
+        return jax.device_put(
+            x, NamedSharding(mesh, _plane_spec(name))
+        )
+
+    k = put(state.cache.k, "cache.k")
+    length = put(state.cache.length, "cache.length")
+    tok = put(state.tok, "tok")
+    return k, length, tok
+
+
+def bad_canon(mesh, state):
+    # Replicating the tp-sharded KV plane: disagrees with the table.
+    k = jax.device_put(state.cache.k, NamedSharding(mesh, P()))  # EXPECT: pspec-flow
+    # Agreeing literal spelling is fine (same canonical meaning).
+    tok = jax.device_put(state.tok, NamedSharding(mesh, P()))
+    return k, tok
+
+
+def debug_gather(mesh, state):
+    # Cold-path full gather for a debug dump; deliberate.
+    return jax.device_put(state.cache.k, NamedSharding(mesh, P()))  # lint: disable=pspec-flow

@@ -1,0 +1,19 @@
+"""Plane-table fixture: the module-level literal spec table IS the
+policy. Keys are plane names, values literal P(...) calls — the shape
+`absint.collect_plane_tables` recognizes (mirrors
+parallel/partition.PAGED_PLANE_SPECS)."""
+
+from jax.sharding import PartitionSpec as P
+
+PLANE_SPECS = {
+    "cache.k": P(None, None, "tp"),
+    "cache.length": P(),
+    "tok": P(),
+}
+
+# Not a spec table (values are not P-calls): must be skipped whole, never
+# treated as policy.
+CLASSIFICATION = {
+    "cache.k": "kv",
+    "tok": "host",
+}

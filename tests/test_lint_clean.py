@@ -287,13 +287,16 @@ PAGED = "distributed_lms_raft_llm_tpu/engine/paged.py"
 def test_semantically_divergent_state_plane_spec_fails_lint():
     """Re-introducing a state-plane spec that differs in MEANING (both
     spellings individually canonical, so `canonical-pspec` stays silent)
-    must fail pspec-flow — the class behind the PR-2 recompile."""
+    must fail pspec-flow — the class behind the PR-2 recompile. Since the
+    plane table took over the policy, the divergence is a producer that
+    stops consulting the table: _canon_state respelling every plane onto
+    dp disagrees with the table's declared specs."""
     from distributed_lms_raft_llm_tpu.analysis.rules.pspec_flow import (
         PSpecFlowRule,
     )
 
     project = _project_with_patch(PAGED, (
-        "sh = jax.sharding.NamedSharding(self.mesh, _state_spec(x))",
+        "sh = jax.sharding.NamedSharding(self.mesh, _plane_spec(name))",
         'sh = jax.sharding.NamedSharding(self.mesh, '
         'jax.sharding.PartitionSpec("dp"))',
     ))
@@ -302,6 +305,8 @@ def test_semantically_divergent_state_plane_spec_fails_lint():
     ]
     assert findings, "a dispatch-boundary respell under a different " \
         "sharding must fail pspec-flow"
+    assert any("plane table" in f.message for f in findings), \
+        "the finding must name the plane table the producer disagrees with"
 
 
 def test_unrebound_donated_state_fails_lint():

@@ -228,6 +228,21 @@ def test_pspec_flow_fixture():
     )
 
 
+def test_plane_table_fixture():
+    """The table-declared half of pspec-flow: a producer that disagrees
+    with the module-level plane table fails lint (the reversion pin for
+    re-replicating a tp-sharded KV plane); name-keyed producers resolving
+    through the table subscript stay silent."""
+    project = run_project_rule(
+        PSpecFlowRule(watch_prefixes=("",)), "plane_table", base=ABSINT
+    )
+    from distributed_lms_raft_llm_tpu.analysis import absint as ai
+    tables = ai.plane_tables(project)
+    assert tables["PLANE_SPECS"]["cache.k"] == "P(None, None, 'tp')"
+    # The non-spec dict (string values) must not masquerade as policy.
+    assert "CLASSIFICATION" not in tables
+
+
 def test_donation_safety_fixture():
     run_project_rule(
         DonationSafetyRule(watch_prefixes=("",)), "donation_safety",
