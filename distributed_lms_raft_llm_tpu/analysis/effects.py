@@ -1,0 +1,476 @@
+"""Interprocedural effect inference over the Project call graph.
+
+PR 4's model answers "who calls whom"; this module answers "what does a
+call *do*" — specifically, which replica-visible side channels a function
+can touch. Every function gets a set drawn from a small effect lattice:
+
+- ``READS_CLOCK``    — ``time.time()``, ``datetime.now()``, ...
+- ``READS_RNG``      — ``random.*``, ``uuid.*``, ``os.urandom``, ...
+- ``READS_ENV``      — ``os.environ`` / ``os.getenv``
+- ``PROCESS_LOCAL``  — ``os.getpid()``, ``id()``, thread identity
+- ``UNORDERED_ITER`` — iterating a set without ``sorted()`` where the
+  loop body writes (hash randomization makes the visit order differ
+  across replica processes, so any insertion-ordered output diverges)
+- ``IO``             — filesystem access (``open``, ``os.remove``, ...)
+- ``RPC_EGRESS``     — awaited gRPC stub calls (CamelCase-attr calls,
+  the repo-wide stub idiom) or anything under ``grpc.*``
+- ``BLOCKING``       — ``time.sleep``, ``subprocess.*``
+
+Leaf effects are recognized *only* when ``Project.resolve_call`` cannot
+resolve the callee to a project-local function — a module that defines
+its own ``open`` or ``id`` shadows the intrinsic, matching Python's own
+name resolution. Effects then close transitively over a spawn-aware copy
+of the call graph:
+
+- calls handed to spawn wrappers (``asyncio.ensure_future``,
+  ``create_task``, ``run_in_executor``, ``loop.call_soon``, ...) are NOT
+  walked into — the work runs off the caller's synchronous path, which
+  is exactly the distinction the determinism rule needs (the LMS applier
+  *spawns* blob replication; it must never *await* it);
+- the ``getattr(self, f"_apply_{...}")`` dispatch idiom is resolved by
+  naming convention: a method whose body builds such an accessor gets
+  edges to every ``_apply_*``-prefixed method of its class.
+
+The closure is a fixpoint over the (small) graph and each Source is
+parsed at most once via the shared cache in ``analysis.core``, so a warm
+``run_lint()`` pays one linear pass — the wall-budget test in
+``tests/test_lint_clean.py`` keeps that honest.
+
+Like the Project model, the engine is unsound-by-design: unresolved
+dynamic dispatch contributes no edge, so rules built on it lose findings
+rather than invent them (see ``analysis/project.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import weakref
+from typing import Dict, FrozenSet, Iterable, List, MutableMapping, Optional, Sequence, Set, Tuple
+
+from .project import FunctionInfo, Project, _dotted
+
+__all__ = [
+    "READS_CLOCK",
+    "READS_RNG",
+    "READS_ENV",
+    "PROCESS_LOCAL",
+    "UNORDERED_ITER",
+    "IO",
+    "RPC_EGRESS",
+    "BLOCKING",
+    "NONDETERMINISM_EFFECTS",
+    "EffectSite",
+    "Witness",
+    "EffectEngine",
+    "effect_engine",
+]
+
+READS_CLOCK = "reads-clock"
+READS_RNG = "reads-rng"
+READS_ENV = "reads-env"
+PROCESS_LOCAL = "process-local"
+UNORDERED_ITER = "unordered-iter"
+IO = "io"
+RPC_EGRESS = "rpc-egress"
+BLOCKING = "blocking"
+
+#: Everything that can make two replicas applying the same command differ,
+#: plus the on-tick-loop hazards (egress/blocking). The determinism rule
+#: forbids the whole set on applier paths.
+NONDETERMINISM_EFFECTS: FrozenSet[str] = frozenset({
+    READS_CLOCK, READS_RNG, READS_ENV, PROCESS_LOCAL, UNORDERED_ITER,
+    IO, RPC_EGRESS, BLOCKING,
+})
+
+# ------------------------------------------------------------ intrinsics
+
+_CLOCK_DOTTED = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today", "date.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+_RNG_PREFIXES = ("random.", "secrets.", "uuid.")
+_RNG_DOTTED = {"os.urandom", "os.getrandom"}
+_RNG_BARE = {"uuid4", "uuid1", "urandom", "token_hex", "token_bytes"}
+_ENV_DOTTED = {"os.getenv", "os.environ.get", "os.environ"}
+_PROCESS_DOTTED = {
+    "os.getpid", "os.getppid",
+    "threading.get_ident", "threading.current_thread",
+}
+_BLOCKING_DOTTED = {"time.sleep"}
+_BLOCKING_PREFIXES = ("subprocess.",)
+_IO_BARE = {"open"}
+_IO_PREFIXES = ("shutil.", "tempfile.")
+_IO_DOTTED = {
+    "os.remove", "os.unlink", "os.replace", "os.rename", "os.makedirs",
+    "os.mkdir", "os.rmdir", "os.listdir", "os.scandir", "os.stat",
+    "os.fsync", "os.open", "os.write", "os.read",
+    "os.path.exists", "os.path.getsize",
+}
+_RPC_PREFIXES = ("grpc.",)
+
+#: Call names (last dotted component) whose ARGUMENTS run off the
+#: caller's synchronous path. The scanner does not descend into them.
+_SPAWN_WRAPPERS = {
+    "ensure_future", "create_task", "add_done_callback",
+    "call_soon", "call_soon_threadsafe", "call_later",
+    "run_in_executor", "to_thread", "Thread",
+}
+
+#: Loop-body operations that count as "the iteration order escaped into
+#: replicated state" for UNORDERED_ITER.
+_MUTATOR_ATTRS = {
+    "append", "add", "insert", "update", "pop", "setdefault",
+    "extend", "remove", "discard",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class EffectSite:
+    """One leaf occurrence of an effect inside a single function."""
+
+    rel: str
+    line: int
+    effect: str
+    detail: str    # human-readable leaf, e.g. "time.time()" or "for over set"
+
+
+@dataclasses.dataclass(frozen=True)
+class Witness:
+    """A call chain from a rule root down to the leaf effect site."""
+
+    chain: Tuple[str, ...]   # qnames, root first
+    site: EffectSite
+
+    def pretty(self) -> str:
+        names = [q.split("::", 1)[-1] for q in self.chain]
+        return " -> ".join(names + [self.site.detail])
+
+
+def _last(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _classify_call(node: ast.Call, *, awaited: bool) -> Optional[Tuple[str, str]]:
+    """(effect, detail) for an *unresolved* call, else None."""
+    dotted = _dotted(node.func)
+    if dotted:
+        tail2 = ".".join(dotted.split(".")[-2:])
+        if dotted in _CLOCK_DOTTED or tail2 in _CLOCK_DOTTED:
+            return (READS_CLOCK, f"{dotted}()")
+        if dotted in _RNG_DOTTED or dotted.startswith(_RNG_PREFIXES) \
+                or _last(dotted) in _RNG_BARE:
+            return (READS_RNG, f"{dotted}()")
+        if dotted in _ENV_DOTTED:
+            return (READS_ENV, f"{dotted}()")
+        if dotted in _PROCESS_DOTTED:
+            return (PROCESS_LOCAL, f"{dotted}()")
+        if dotted in _BLOCKING_DOTTED or dotted.startswith(_BLOCKING_PREFIXES):
+            return (BLOCKING, f"{dotted}()")
+        if dotted in _IO_DOTTED or dotted.startswith(_IO_PREFIXES) \
+                or dotted in _IO_BARE:
+            return (IO, f"{dotted}()")
+        if dotted.startswith(_RPC_PREFIXES):
+            return (RPC_EGRESS, f"{dotted}()")
+        if dotted == "id" and len(node.args) == 1:
+            return (PROCESS_LOCAL, "id()")
+    # gRPC stub idiom: an awaited CamelCase-attribute call, or one carrying
+    # a timeout= kwarg (matches the trace-propagation rule's heuristic).
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr[:1].isupper() and (
+            awaited or any(k.arg == "timeout" for k in node.keywords)
+        ):
+            return (RPC_EGRESS, f".{attr}(...)")
+    return None
+
+
+def _is_setlike(node: ast.expr, setlike_names: Set[str]) -> bool:
+    """Does this expression evaluate to hash-ordered contents?"""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in setlike_names
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("set", "frozenset"):
+            return True
+        # list(set(x)) / tuple(set(x)) freeze the hash order, they do
+        # not impose one; sorted(set(x)) does and is therefore clean.
+        if node.func.id in ("list", "tuple") and node.args:
+            return _is_setlike(node.args[0], setlike_names)
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+    ):
+        return _is_setlike(node.left, setlike_names) or _is_setlike(
+            node.right, setlike_names
+        )
+    return False
+
+
+def _body_writes(body: Sequence[ast.stmt]) -> bool:
+    """Does a loop body write somewhere the iteration order can escape?"""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        return True
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATOR_ATTRS:
+                return True
+    return False
+
+
+class _FunctionScan:
+    """Spawn-aware single pass over one function body: leaf effect sites,
+    resolved call edges, and convention-dispatch prefixes."""
+
+    def __init__(self, project: Project, fn: FunctionInfo):
+        self.project = project
+        self.fn = fn
+        self.mod = project.modules[fn.rel]
+        self.sites: List[EffectSite] = []
+        self.edges: Set[str] = set()
+        self.dispatch_prefixes: Set[str] = set()
+        self._setlike: Set[str] = set()
+        self._seen: Set[Tuple[int, str]] = set()
+        body = getattr(fn.node, "body", [])
+        for stmt in body:
+            self._scan(stmt)
+
+    def _add_site(self, line: int, effect: str, detail: str) -> None:
+        key = (line, effect)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.sites.append(EffectSite(self.fn.rel, line, effect, detail))
+
+    def _scan(self, node: ast.AST, *, awaited: bool = False) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs own their bodies; the parent->nested edge is
+            # added by the engine (defining implies it may run).
+            return
+        if isinstance(node, ast.Await):
+            self._scan(node.value, awaited=True)
+            return
+        if isinstance(node, ast.Call):
+            self._scan_call(node, awaited=awaited)
+            return
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if _dotted(node) == "os.environ":
+                self._add_site(node.lineno, READS_ENV, "os.environ")
+            if isinstance(node, ast.Attribute):
+                self._scan(node.value)
+            return
+        if isinstance(node, ast.Assign):
+            self._scan(node.value)
+            if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                if _is_setlike(node.value, self._setlike):
+                    self._setlike.add(node.targets[0].id)
+                else:
+                    self._setlike.discard(node.targets[0].id)
+            for t in node.targets:
+                self._scan(t)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._scan_for(node)
+            return
+        if isinstance(node, (ast.ListComp, ast.DictComp, ast.GeneratorExp)):
+            self._scan_comp(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan(child)
+
+    def _scan_call(self, node: ast.Call, *, awaited: bool) -> None:
+        dotted = _dotted(node.func)
+        if dotted and _last(dotted) in _SPAWN_WRAPPERS:
+            # The arguments run off this function's synchronous path:
+            # record nothing and do not descend.
+            return
+        self._detect_dispatch(node)
+        callee = self.project.resolve_call(
+            self.mod, node.func, self.fn.class_name, self.fn
+        )
+        if callee is not None:
+            self.edges.add(callee.qname)
+        else:
+            hit = _classify_call(node, awaited=awaited)
+            if hit is not None:
+                self._add_site(node.lineno, hit[0], hit[1])
+        for child in ast.iter_child_nodes(node):
+            if child is node.func and isinstance(child, ast.Attribute):
+                self._scan(child.value)
+                continue
+            if child is node.func:
+                continue
+            self._scan(child)
+
+    def _detect_dispatch(self, node: ast.Call) -> None:
+        """`getattr(self, f"_apply_{op}")` -> dispatch prefix "_apply_"."""
+        if not (isinstance(node.func, ast.Name) and node.func.id == "getattr"):
+            return
+        if len(node.args) < 2:
+            return
+        if not (isinstance(node.args[0], ast.Name)
+                and node.args[0].id == "self"):
+            return
+        key = node.args[1]
+        if isinstance(key, ast.JoinedStr) and key.values:
+            first = key.values[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str) and first.value:
+                self.dispatch_prefixes.add(first.value)
+
+    def _scan_for(self, node: ast.AST) -> None:
+        it = node.iter  # type: ignore[attr-defined]
+        body = node.body  # type: ignore[attr-defined]
+        orelse = node.orelse  # type: ignore[attr-defined]
+        if _is_setlike(it, self._setlike) and _body_writes(body):
+            self._add_site(
+                node.lineno,  # type: ignore[attr-defined]
+                UNORDERED_ITER,
+                "for over set (hash order)",
+            )
+        self._scan(it)
+        for stmt in list(body) + list(orelse):
+            self._scan(stmt)
+
+    def _scan_comp(self, node: ast.expr) -> None:
+        # A list/dict comprehension over a set freezes hash order into an
+        # ordered container — unless it feeds straight into sorted().
+        parent = getattr(node, "parent", None)
+        in_sorted = (
+            isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Name)
+            and parent.func.id == "sorted"
+        )
+        gens = node.generators  # type: ignore[attr-defined]
+        if not in_sorted and not isinstance(node, ast.GeneratorExp):
+            for gen in gens:
+                if _is_setlike(gen.iter, self._setlike):
+                    self._add_site(
+                        node.lineno, UNORDERED_ITER,
+                        "comprehension over set (hash order)",
+                    )
+                    break
+        for child in ast.iter_child_nodes(node):
+            self._scan(child)
+
+
+class EffectEngine:
+    """Per-function effect sets closed over a spawn-aware call graph."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._sites: Dict[str, List[EffectSite]] = {}
+        self._edges: Dict[str, Set[str]] = {}
+        self._effects: Dict[str, Set[str]] = {}
+        self._build()
+        self._close()
+
+    # ------------------------------------------------------------- build
+
+    def _build(self) -> None:
+        for qname, fn in self.project.functions.items():
+            scan = _FunctionScan(self.project, fn)
+            edges = set(scan.edges)
+            if fn.parent is not None:
+                self._edges.setdefault(fn.parent, set()).add(qname)
+            for prefix in scan.dispatch_prefixes:
+                edges |= self._convention_targets(fn, prefix)
+            self._sites[qname] = scan.sites
+            self._edges.setdefault(qname, set()).update(edges)
+
+    def _convention_targets(self, fn: FunctionInfo, prefix: str) -> Set[str]:
+        if fn.class_name is None:
+            return set()
+        cls = self.project.classes.get(f"{fn.rel}::{fn.class_name}")
+        if cls is None:
+            return set()
+        return {
+            m.qname for name, m in cls.methods.items()
+            if name.startswith(prefix)
+        }
+
+    def _close(self) -> None:
+        for qname in self.project.functions:
+            self._effects[qname] = {s.effect for s in self._sites.get(qname, ())}
+        changed = True
+        while changed:
+            changed = False
+            for qname in self.project.functions:
+                eff = self._effects[qname]
+                before = len(eff)
+                for callee in self._edges.get(qname, ()):
+                    callee_eff = self._effects.get(callee)
+                    if callee_eff:
+                        eff |= callee_eff
+                if len(eff) != before:
+                    changed = True
+
+    # ----------------------------------------------------------- queries
+
+    def effects(self, qname: str) -> FrozenSet[str]:
+        return frozenset(self._effects.get(qname, ()))
+
+    def local_sites(self, qname: str) -> List[EffectSite]:
+        return list(self._sites.get(qname, ()))
+
+    def callees(self, qname: str) -> Set[str]:
+        return set(self._edges.get(qname, ()))
+
+    def reachable(self, roots: Iterable[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.project.functions]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self._edges.get(cur, set()) - seen)
+        return seen
+
+    def witness(self, root: str, effect: str) -> Optional[Witness]:
+        """Shortest call chain from `root` to a local site of `effect`
+        (BFS, neighbors in sorted order, so the chain is deterministic)."""
+        if effect not in self.effects(root):
+            return None
+        parent: Dict[str, Optional[str]] = {root: None}
+        queue: List[str] = [root]
+        while queue:
+            cur = queue.pop(0)
+            for site in self._sites.get(cur, ()):
+                if site.effect == effect:
+                    chain: List[str] = []
+                    walk: Optional[str] = cur
+                    while walk is not None:
+                        chain.append(walk)
+                        walk = parent[walk]
+                    return Witness(tuple(reversed(chain)), site)
+            for nxt in sorted(self._edges.get(cur, ())):
+                if nxt not in parent and effect in self.effects(nxt):
+                    parent[nxt] = cur
+                    queue.append(nxt)
+        return None
+
+
+# One engine per Project instance: both effect rules (and any future one)
+# share the build. Weak keys keep test-constructed throwaway Projects
+# collectable.
+_ENGINES: MutableMapping[Project, EffectEngine] = weakref.WeakKeyDictionary()
+
+
+def effect_engine(project: Project) -> EffectEngine:
+    engine = _ENGINES.get(project)
+    if engine is None:
+        engine = EffectEngine(project)
+        _ENGINES[project] = engine
+    return engine

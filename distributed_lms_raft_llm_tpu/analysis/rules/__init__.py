@@ -7,7 +7,9 @@ metrics-registry, config-consistency, guarded-by-flow) run on the
 whole-repo symbol table + call graph in analysis/project.py; the
 abstract-interpretation rules (pspec-flow, donation-safety, dtype-flow,
 program-inventory) additionally propagate values — sharding meaning,
-dtype, donation status, compiled-program domains — via analysis/absint.py.
+dtype, donation status, compiled-program domains — via analysis/absint.py;
+the effect/taint rules (state-machine-determinism, wire-taint) run on the
+interprocedural effect lattice in analysis/effects.py.
 """
 
 from . import (  # noqa: F401
@@ -26,6 +28,8 @@ from . import (  # noqa: F401
     program_inventory,
     pspec_flow,
     slow_marker,
+    state_machine_determinism,
     trace_propagation,
     tracer_hygiene,
+    wire_taint,
 )

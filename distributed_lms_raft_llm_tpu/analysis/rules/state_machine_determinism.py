@@ -1,0 +1,147 @@
+"""state-machine-determinism: the Raft applier path must be effect-clean.
+
+Incident class: five replicas apply the identical command log and end up
+byte-different. Every way that happens is an *effect* reachable from an
+applier — a `time.time()` grading timestamp, a `uuid.uuid4()` minted
+inside `_apply_login` (instead of leader-side, pre-propose, riding the
+Entry), an `os.environ` read, `os.getpid()` leaking into state, a `for`
+over a `set()` whose hash order differs per process (PYTHONHASHSEED), or
+an RPC/blocking call stalling the tick loop so apply cadence diverges.
+Example-based tests only catch the divergence they happen to trigger;
+this rule closes the whole class statically.
+
+Roots (the replicated-apply surface):
+
+- every class that owns ``_apply_*`` methods contributes its ``apply``
+  dispatcher (the ``getattr(self, f"_apply_{op}")`` idiom is resolved by
+  naming convention in :mod:`analysis.effects`), its ``replace``
+  (snapshot install), and each ``_apply_*`` handler — this covers
+  ``LMSState`` and the WAL's record replay alike;
+- any function wired as a Raft callback via an ``apply_cb=`` /
+  ``install_cb=`` keyword (``LMSNode._apply``, reshard-journal replay).
+
+Forbidden: the full nondeterminism set — clock/RNG/env/process-local
+reads, un-``sorted()`` set iteration escaping into writes, filesystem
+I/O, RPC egress, and blocking calls. Spawned work
+(``asyncio.ensure_future(replicate_file_to_peers(...))``) is off the
+synchronous path and exempt by construction.
+
+Remedies, in preference order: mint ids/tokens/salts leader-side before
+propose (see ``lms/minting.py``) so they ride the Entry; sort the
+iteration; move the side effect off the apply path. A deliberate
+exception (e.g. the snapshot-cadence save inside ``LMSNode._apply``,
+which writes the same bytes on every replica) is sanctioned in place
+with ``# lint: disable=state-machine-determinism`` and a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import FrozenSet, List, Sequence, Set, Tuple
+
+from ..core import Finding, register
+from ..effects import NONDETERMINISM_EFFECTS, effect_engine
+from ..project import Project, ProjectRule
+
+_APPLY_METHOD = re.compile(r"_apply_\w+$")
+
+#: Keyword names that wire a function into the Raft apply path.
+_CALLBACK_KWARGS = ("apply_cb", "install_cb")
+
+DEFAULT_WATCH = ("distributed_lms_raft_llm_tpu/",)
+
+
+@register
+class StateMachineDeterminismRule(ProjectRule):
+    name = "state-machine-determinism"
+    description = (
+        "functions reachable from the Raft applier path must be free of "
+        "clock/RNG/env/process-local reads, unordered set iteration, "
+        "I/O, RPC egress, and blocking calls"
+    )
+
+    def __init__(
+        self,
+        watch_prefixes: Sequence[str] = DEFAULT_WATCH,
+        forbidden: FrozenSet[str] = NONDETERMINISM_EFFECTS,
+    ):
+        self.watch_prefixes = tuple(watch_prefixes)
+        self.forbidden = frozenset(forbidden)
+
+    # --------------------------------------------------------------- roots
+
+    def _watched(self, rel: str) -> bool:
+        return any(rel.startswith(p) for p in self.watch_prefixes)
+
+    def _roots(self, project: Project) -> Set[str]:
+        roots: Set[str] = set()
+        for key, cls in project.classes.items():
+            if not self._watched(cls.rel):
+                continue
+            appliers = [
+                m for name, m in cls.methods.items()
+                if _APPLY_METHOD.match(name)
+            ]
+            if not appliers:
+                continue
+            roots.update(m.qname for m in appliers)
+            for entry in ("apply", "replace"):
+                if entry in cls.methods:
+                    roots.add(cls.methods[entry].qname)
+        roots.update(self._callback_roots(project))
+        return roots
+
+    def _callback_roots(self, project: Project) -> Set[str]:
+        """Functions passed as apply_cb=/install_cb= keyword values."""
+        roots: Set[str] = set()
+        for rel, mod in project.modules.items():
+            if not self._watched(rel):
+                continue
+            for fn in project.functions.values():
+                if fn.rel != rel:
+                    continue
+                for node in ast.walk(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for kw in node.keywords:
+                        if kw.arg not in _CALLBACK_KWARGS:
+                            continue
+                        target = project.resolve_call(
+                            mod, kw.value, fn.class_name, fn
+                        )
+                        if target is not None:
+                            roots.add(target.qname)
+        return roots
+
+    # ------------------------------------------------------------ findings
+
+    def check_project(self, project: Project) -> List[Finding]:
+        engine = effect_engine(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+        for root in sorted(self._roots(project)):
+            bad = engine.effects(root) & self.forbidden
+            for effect in sorted(bad):
+                witness = engine.witness(root, effect)
+                if witness is None:  # pragma: no cover - closure guarantees it
+                    continue
+                site = witness.site
+                key = (site.rel, site.line, effect)
+                if key in seen:
+                    continue
+                seen.add(key)
+                src = project.sources.get(site.rel)
+                if src is None:  # pragma: no cover - sites come from sources
+                    continue
+                root_name = root.split("::", 1)[-1]
+                findings.append(self.finding(
+                    src, site.line,
+                    f"{effect} on the replicated apply path: "
+                    f"{witness.pretty()} (root {root_name}). Replicas "
+                    "applying the same entry must not observe "
+                    f"{effect}; mint values pre-propose so they ride "
+                    "the Entry, sort the iteration, or move the side "
+                    "effect off the apply path.",
+                ))
+        return findings

@@ -1,0 +1,404 @@
+"""wire-taint: untrusted wire fields must pass a sanitizer before a sink.
+
+Incident class: PR 16 put a real trust boundary into the router — client
+metadata is unsigned, router->member metadata carries an HMAC
+(`sign_router_metadata` / `_signed_md`, verified with
+`hmac.compare_digest`). Everything security-relevant that arrives over
+the wire must cross that boundary through a sanctioner:
+
+- **trust metadata** (`x-lms-*` keys) may only be read through
+  ``_signed_md`` (or the router's own ``_InnerContext`` /
+  ``_forced_auth`` shims). Reading ``x-lms-group`` out of raw
+  ``invocation_metadata()`` — directly, via a dict, in a ``for k, v``
+  scan, or laundered through a generic raw reader such as
+  ``_metadata_get`` — lets any client steer group routing or forge the
+  router leg. (``x-lms-user`` is the documented *unsigned hint* used
+  only to pin sticky routing; it is exempt.)
+- **request fields** must not reach filesystem path construction
+  (``open``, ``os.path.join``, ``os.remove``...) without a sanitizing
+  hop; the blob store's ``_resolve`` escape-guard is the sanctioned
+  path sink.
+- **secret comparisons** (password hashes, tokens, signatures) must use
+  ``hmac.compare_digest`` — ``==`` on attacker-influenced digests is a
+  timing oracle.
+
+Taint propagates through straight-line assignments inside a function and
+one forwarding hop into a project-resolvable callee (a tainted argument
+taints the matching parameter); deeper laundering is out of scope and is
+instead constrained by keeping the sanctioner list short and named.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from ..core import Finding, register
+from ..project import FunctionInfo, ModuleInfo, Project, ProjectRule, _dotted
+
+DEFAULT_WATCH = ("distributed_lms_raft_llm_tpu/lms/",)
+
+#: Functions allowed to touch raw invocation_metadata: the verifier, the
+#: forced-auth gate (which checks the router-leg marker first), the
+#: router's context shims, and the signer itself.
+SANCTIONED_FUNCS: FrozenSet[str] = frozenset({
+    "_signed_md", "_forced_auth", "sign_router_metadata",
+    "invocation_metadata",
+})
+
+#: Metadata VALUES that are documented unsigned hints (sticky-routing
+#: only, never trust decisions).
+EXEMPT_KEYS: FrozenSet[str] = frozenset({"x-lms-user"})
+
+_WIRE_PREFIX = "x-lms-"
+
+#: Call names whose result is a secret digest/signature.
+_HASH_FNS = frozenset({
+    "hash_password", "pbkdf2_hmac", "sign_query", "sign_router_metadata",
+    "hexdigest",
+})
+
+#: Identifier terminals that denote stored/presented secrets.
+_SECRET_TERMS = frozenset({
+    "password", "password_hash", "token", "auth_token", "secret",
+    "signature", "router_sig", "sig",
+})
+
+#: Filesystem path sinks for request-field taint.
+_PATH_SINKS = frozenset({
+    "open", "os.path.join", "os.remove", "os.unlink", "os.makedirs",
+    "os.rename", "os.replace", "os.rmdir", "os.open",
+})
+
+#: A call through one of these names sanitizes its argument.
+_SANITIZERS = ("sanitize", "secure_filename", "basename")
+
+
+def _module_consts(mod: ModuleInfo) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in mod.src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+@register
+class WireTaintRule(ProjectRule):
+    name = "wire-taint"
+    description = (
+        "untrusted wire fields (raw gRPC metadata, request fields) must "
+        "pass the sanctioner (_signed_md, blob-store resolve, "
+        "hmac.compare_digest) before trust decisions, paths, or secret "
+        "comparisons"
+    )
+
+    def __init__(self, watch_prefixes: Sequence[str] = DEFAULT_WATCH):
+        self.watch_prefixes = tuple(watch_prefixes)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _watched(self, rel: str) -> bool:
+        return any(rel.startswith(p) for p in self.watch_prefixes)
+
+    def _key_value(
+        self, project: Project, mod: ModuleInfo, node: ast.expr,
+        consts: Dict[str, Dict[str, str]],
+    ) -> Optional[str]:
+        """The string a metadata-key expression denotes, if visible."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            local = consts.setdefault(mod.rel, _module_consts(mod))
+            if node.id in local:
+                return local[node.id]
+            imp = mod.imports.get(node.id)
+            if imp is not None and imp[0] == "sym":
+                other = project.modules.get(imp[1])
+                if other is not None:
+                    omap = consts.setdefault(other.rel, _module_consts(other))
+                    return omap.get(imp[2])
+        return None
+
+    def _sensitive(self, value: Optional[str]) -> bool:
+        return (
+            value is not None
+            and value.startswith(_WIRE_PREFIX)
+            and value not in EXEMPT_KEYS
+        )
+
+    @staticmethod
+    def _raw_meta_call(node: ast.expr) -> bool:
+        """Does this expression contain a raw invocation_metadata() read?"""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "invocation_metadata":
+                return True
+        return False
+
+    # -------------------------------------------------------------- check
+
+    def check_project(self, project: Project) -> List[Finding]:
+        consts: Dict[str, Dict[str, str]] = {}
+        raw_readers = self._raw_readers(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int]] = set()
+
+        def emit(rel: str, line: int, message: str) -> None:
+            if (rel, line) in seen:
+                return
+            src = project.sources.get(rel)
+            if src is None:  # pragma: no cover - functions come from sources
+                return
+            seen.add((rel, line))
+            findings.append(self.finding(src, line, message))
+
+        for fn in project.functions.values():
+            if not self._watched(fn.rel):
+                continue
+            if fn.name in SANCTIONED_FUNCS:
+                continue
+            self._check_function(
+                project, fn, consts, raw_readers, emit,
+                pre_tainted=frozenset(), hop=True,
+            )
+        return findings
+
+    def _raw_readers(self, project: Project) -> Set[str]:
+        """Project functions whose body reads raw invocation_metadata —
+        calling one with an x-lms key is laundering, not sanitizing."""
+        out: Set[str] = set()
+        for qname, fn in project.functions.items():
+            if fn.name in SANCTIONED_FUNCS:
+                continue
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "invocation_metadata":
+                    out.add(qname)
+                    break
+        return out
+
+    # ---------------------------------------------------- per-function scan
+
+    def _check_function(
+        self, project: Project, fn: FunctionInfo,
+        consts: Dict[str, Dict[str, str]],
+        raw_readers: Set[str], emit,
+        *, pre_tainted: FrozenSet[str], hop: bool,
+    ) -> None:
+        mod = project.modules[fn.rel]
+
+        tainted = self._tainted_locals(fn, pre_tainted)
+        path_tainted = self._path_tainted_locals(project, mod, fn)
+
+        def is_tainted(expr: ast.expr) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in tainted
+            return self._raw_meta_call(expr)
+
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                self._check_call(
+                    project, mod, fn, node, consts, raw_readers,
+                    tainted, path_tainted, is_tainted, emit, hop,
+                )
+            elif isinstance(node, ast.Subscript):
+                key = self._key_value(project, mod, node.slice, consts)
+                if self._sensitive(key) and is_tainted(node.value):
+                    emit(fn.rel, node.lineno, self._trust_msg(key))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if is_tainted(node.iter):
+                    self._check_meta_scan(
+                        project, mod, fn, node, consts, emit
+                    )
+            elif isinstance(node, ast.Compare) and hop:
+                # Secret comparisons only flagged in the outer pass — a
+                # forwarded hop re-walking them would double-report.
+                self._check_secret_compare(fn, node, emit)
+
+    def _tainted_locals(
+        self, fn: FunctionInfo, pre_tainted: FrozenSet[str]
+    ) -> Set[str]:
+        tainted: Set[str] = set(pre_tainted)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                value_bad = self._raw_meta_call(node.value) or any(
+                    isinstance(sub, ast.Name) and sub.id in tainted
+                    for sub in ast.walk(node.value)
+                )
+                if not value_bad:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name) \
+                            and target.id not in tainted:
+                        tainted.add(target.id)
+                        changed = True
+        return tainted
+
+    def _path_tainted_locals(
+        self, project: Project, mod: ModuleInfo, fn: FunctionInfo
+    ) -> Set[str]:
+        """Locals derived from request.<field> without a sanitizing hop."""
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if self._sanitizer_call(node.value):
+                    continue
+                if not self._request_derived(node.value, tainted):
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name) \
+                            and target.id not in tainted:
+                        tainted.add(target.id)
+                        changed = True
+        return tainted
+
+    @staticmethod
+    def _sanitizer_call(expr: ast.expr) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        dotted = _dotted(expr.func)
+        tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+        return any(s in tail for s in _SANITIZERS)
+
+    @staticmethod
+    def _request_derived(expr: ast.expr, tainted: Set[str]) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == "request":
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+        return False
+
+    # ------------------------------------------------------------ detectors
+
+    def _trust_msg(self, key: Optional[str]) -> str:
+        return (
+            f"trust metadata {key!r} read from RAW invocation_metadata — "
+            "any client can set it. Route the read through _signed_md() "
+            "so only HMAC-signed router metadata is honored."
+        )
+
+    def _check_call(
+        self, project: Project, mod: ModuleInfo, fn: FunctionInfo,
+        node: ast.Call, consts: Dict[str, Dict[str, str]],
+        raw_readers: Set[str], tainted: Set[str], path_tainted: Set[str],
+        is_tainted, emit, hop: bool,
+    ) -> None:
+        dotted = _dotted(node.func)
+        # .get(<x-lms key>) on a raw-metadata-derived mapping.
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "get" \
+                and node.args:
+            key = self._key_value(project, mod, node.args[0], consts)
+            if self._sensitive(key) and is_tainted(node.func.value):
+                emit(fn.rel, node.lineno, self._trust_msg(key))
+                return
+        # Filesystem path sinks fed by request fields.
+        if dotted in _PATH_SINKS:
+            for arg in node.args:
+                if self._sanitizer_call(arg):
+                    continue
+                if self._request_derived(arg, path_tainted):
+                    emit(
+                        fn.rel, node.lineno,
+                        f"request field reaches path sink {dotted}() "
+                        "without a sanitizing hop — route through the "
+                        "blob store's _resolve (escape-guarded) or a "
+                        "sanitize_*() helper.",
+                    )
+                    return
+        callee = project.resolve_call(mod, node.func, fn.class_name, fn)
+        if callee is None:
+            return
+        # Laundering through a generic raw reader: _metadata_get(ctx, KEY).
+        if callee.qname in raw_readers:
+            for arg in node.args:
+                key = self._key_value(project, mod, arg, consts)
+                if self._sensitive(key):
+                    emit(
+                        fn.rel, node.lineno,
+                        f"trust metadata {key!r} fetched via "
+                        f"{callee.name}(), which reads RAW "
+                        "invocation_metadata — a sanctioner bypass. Use "
+                        "_signed_md() for x-lms-* trust keys.",
+                    )
+                    return
+        # One forwarding hop: tainted argument -> callee parameter.
+        if hop and self._watched(callee.rel) \
+                and callee.name not in SANCTIONED_FUNCS:
+            params = [
+                a.arg for a in callee.node.args.args  # type: ignore[attr-defined]
+                if a.arg != "self"
+            ]
+            forwarded: Set[str] = set()
+            args = list(node.args)
+            for i, arg in enumerate(args):
+                if i < len(params) and is_tainted(arg):
+                    forwarded.add(params[i])
+            if forwarded:
+                self._check_function(
+                    project, callee, consts, raw_readers, emit,
+                    pre_tainted=frozenset(forwarded), hop=False,
+                )
+
+    def _check_meta_scan(
+        self, project: Project, mod: ModuleInfo, fn: FunctionInfo,
+        loop: ast.AST, consts: Dict[str, Dict[str, str]], emit,
+    ) -> None:
+        """`for k, v in <raw metadata>` comparing k to an x-lms key."""
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Compare):
+                continue
+            for side in [node.left] + list(node.comparators):
+                key = self._key_value(project, mod, side, consts)
+                if self._sensitive(key):
+                    emit(fn.rel, node.lineno, self._trust_msg(key))
+                    break
+
+    def _check_secret_compare(
+        self, fn: FunctionInfo, node: ast.Compare, emit
+    ) -> None:
+        if not all(isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            return
+        sides = [node.left] + list(node.comparators)
+        # `password == ""` style emptiness probes are not timing oracles.
+        if any(isinstance(s, ast.Constant) for s in sides):
+            return
+        if any(self._secretish(s) for s in sides):
+            emit(
+                fn.rel, node.lineno,
+                "secret compared with ==/!= — a timing oracle on "
+                "attacker-influenced input. Use hmac.compare_digest().",
+            )
+
+    @staticmethod
+    def _secretish(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Call):
+            dotted = _dotted(expr.func)
+            if dotted and dotted.rsplit(".", 1)[-1] in _HASH_FNS:
+                return True
+            return False
+        terminal = ""
+        if isinstance(expr, ast.Name):
+            terminal = expr.id
+        elif isinstance(expr, ast.Attribute):
+            terminal = expr.attr
+        elif isinstance(expr, ast.Subscript) \
+                and isinstance(expr.slice, ast.Constant) \
+                and isinstance(expr.slice.value, str):
+            terminal = expr.slice.value
+        return terminal in _SECRET_TERMS

@@ -42,8 +42,6 @@ import hashlib
 import hmac
 import json
 import logging
-import os
-import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
 
@@ -59,6 +57,7 @@ from ..utils.resilience import (
     request_id_from_grpc_context,
 )
 from ..utils.tracing import trace_metadata
+from .minting import mint_salt, mint_session_token
 from .state import LMSState
 
 log = logging.getLogger("lms.group_router")
@@ -634,9 +633,9 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
         if name == "Register":
             stored = self._nodes[0].state.data["users"].get(request.username)
             salt = stored.get("salt", "") if stored else ""
-            extra.append((AUTH_SALT_METADATA_KEY, salt or os.urandom(16).hex()))
+            extra.append((AUTH_SALT_METADATA_KEY, salt or mint_salt()))
         elif name == "Login":
-            extra.append((AUTH_TOKEN_METADATA_KEY, uuid.uuid4().hex))
+            extra.append((AUTH_TOKEN_METADATA_KEY, mint_session_token()))
         primary = await self._execute(0, name, request, context, extra_md=extra)
         if getattr(primary, "success", True):
             for gid in self.group_ids():
@@ -1006,6 +1005,10 @@ class GroupsAdmin:
                 "term": raft.core.current_term,
                 "applied": raft.core.last_applied,
                 "commit": raft.core.commit_index,
+                # Replica digest chain (LMSNode._fold_digest): replicas
+                # of one group at equal digest_applied must agree here.
+                "digest": lms_node.state_digest,
+                "digest_applied": lms_node._last_applied_index,
             }
         return {"routing_map": routing, "groups": groups}
 

@@ -30,7 +30,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-import uuid
 from typing import Dict, Optional
 
 import grpc
@@ -54,6 +53,7 @@ from ..utils.tracing import (
     traced_grpc_handler,
 )
 from .group_router import AUTH_SALT_METADATA_KEY, AUTH_TOKEN_METADATA_KEY
+from .minting import mint_request_id, mint_salt, mint_session_token
 from .persistence import BlobStore
 from .state import LMSState, hash_password
 from .tutoring_pool import TutoringPool, TutoringUnavailable
@@ -264,7 +264,7 @@ class LMSServicer(rpc.LMSServicer):
                     encode_command(
                         "AskQuery",
                         {"username": username, "query": query,
-                         "request_id": request_id or uuid.uuid4().hex},
+                         "request_id": request_id or mint_request_id()},
                     )
                 )
         except (NotLeader, TransferInFlight, TimeoutError, RuntimeError) as e:
@@ -391,7 +391,7 @@ class LMSServicer(rpc.LMSServicer):
         # the same (salt, hash) pair, so the KDF stays deterministic across
         # the cluster while each user gets a unique salt. The group router
         # forces one salt across its per-group legs.
-        salt = _forced_auth(context, AUTH_SALT_METADATA_KEY) or os.urandom(16).hex()
+        salt = _forced_auth(context, AUTH_SALT_METADATA_KEY) or mint_salt()
         pw_hash = hash_password(request.password, salt)
         await self._propose(
             "Register",
@@ -424,7 +424,8 @@ class LMSServicer(rpc.LMSServicer):
         self.metrics.inc("login")
         if not self.state.check_password(request.username, request.password):
             return lms_pb2.LoginResponse(success=False)
-        token = _forced_auth(context, AUTH_TOKEN_METADATA_KEY) or uuid.uuid4().hex
+        token = _forced_auth(context, AUTH_TOKEN_METADATA_KEY) \
+            or mint_session_token()
         await self._propose(
             "Login", {"username": request.username, "token": token}, context
         )
@@ -801,10 +802,13 @@ class FileTransferServicer(rpc.FileTransferServiceServicer):
     async def ReplicateData(self, request, context):
         """Direct blob push (metadata rides Raft; this is the bulk path)."""
         try:
-            sub = "materials" if request.type == "material" else os.path.join(
+            # Sanctioned path joins: `rel` is blob-RELATIVE and only ever
+            # reaches BlobStore.put, whose _resolve escape-guard rejects
+            # any traversal out of the blob root (see FetchFile above).
+            sub = "materials" if request.type == "material" else os.path.join(  # lint: disable=wire-taint
                 "assignments", request.username or "unknown"
             )
-            rel = os.path.join(sub, os.path.basename(request.filename))
+            rel = os.path.join(sub, os.path.basename(request.filename))  # lint: disable=wire-taint
             self.blobs.put(rel, request.file_content)
             return lms_pb2.ReplicateDataResponse(success=True)
         except Exception as e:

@@ -802,7 +802,19 @@ class RaftCore:
         return self.last_log_index
 
     def take_applies(self) -> List[Tuple[int, Entry]]:
-        """Entries newly committed since the last call (for the app FSM)."""
+        """Entries newly committed since the last call (for the app FSM).
+
+        DETERMINISM CONTRACT: whatever the runner feeds these entries to
+        (`RaftNode.apply_cb`, and transitively the whole `LMSState`
+        applier surface) must be a pure function of (index, entry) over
+        the prior state — no clock/RNG/env/process-identity reads, no
+        unordered set iteration escaping into state, no blocking I/O or
+        RPC awaited on the tick loop. Anything a replica should record
+        that is not derivable from the entry (timestamps, tokens, salts,
+        request ids) is minted leader-side BEFORE propose and rides in
+        `Entry.command` (see lms/minting.py). Enforced statically by the
+        `state-machine-determinism` lint rule and at runtime by the
+        per-apply state-digest chain (`LMSNode._fold_digest`)."""
         out = []
         while self.last_applied < self.commit_index:
             self.last_applied += 1

@@ -203,6 +203,12 @@ class SemesterSim:
                 telemetry.engine.finish(scheduler.event_windows())
             self._settle()
             self._audit()
+            # After the audit (its logins are themselves replicated
+            # writes): wait for every group's replicas to drain to one
+            # applied index and compare their state-digest chains.
+            self.ledger.note_replica_digests(
+                self._collect_replica_digests()
+            )
             node_metrics, node_health = self.cluster.scrape_all()
             traces = get_tracer().records()
             fleet = self._fleet_summary(node_metrics, node_health)
@@ -631,6 +637,48 @@ class SemesterSim:
             "nodes": nodes,
         }
 
+    def _collect_replica_digests(self) -> Optional[Dict]:
+        """Cross-replica convergence audit at settle (replicas_converged
+        SLO): each physical node reports, per Raft group, its replica's
+        digest chain (GET /admin/raft -> digest / digest_applied). A
+        group converged when every responding replica sits at the SAME
+        applied index with the SAME digest — including across a mid-run
+        group split, whose InstallSnapshot-restored members must resume
+        the source chain, not fork it. Replicas drain asynchronously, so
+        poll briefly before judging; unreachable nodes are skipped (a
+        node the drill killed proves nothing about determinism)."""
+        if self.cfg.lms_groups <= 1:
+            return None
+        deadline = time.monotonic() + 15.0
+        doc: Dict = {"converged": False, "groups": {}}
+        while True:
+            per_group: Dict[str, Dict[str, Dict]] = {}
+            for nid in self.cluster.node_ids():
+                try:
+                    topo = self.cluster.group_topology(nid)
+                except (RuntimeError, OSError):
+                    continue
+                for gid, row in (topo.get("groups") or {}).items():
+                    if "digest" not in row:
+                        continue
+                    per_group.setdefault(gid, {})[str(nid)] = {
+                        "applied": row.get("digest_applied"),
+                        "digest": row.get("digest"),
+                    }
+            converged = bool(per_group)
+            for rows in per_group.values():
+                if len(rows) < 2:
+                    converged = False  # one report compares nothing
+                    continue
+                if len({r["applied"] for r in rows.values()}) != 1:
+                    converged = False  # still draining (or wedged)
+                elif len({r["digest"] for r in rows.values()}) != 1:
+                    converged = False  # SAME index, DIFFERENT state
+            doc = {"converged": converged, "groups": per_group}
+            if converged or time.monotonic() > deadline:
+                return doc
+            time.sleep(0.3)
+
     def _groups_summary(self) -> Optional[Dict]:
         """Sharded-control-plane verdict inputs: the final routing map
         and per-group topology (GET /admin/raft), per-group leaders from
@@ -658,6 +706,7 @@ class SemesterSim:
             "acked_across_reshard": ledger_report.get(
                 "acked_across_reshard", 0
             ),
+            "replica_digests": ledger_report.get("replica_digests"),
         }
 
     def _scoring_summary(self) -> Optional[Dict]:

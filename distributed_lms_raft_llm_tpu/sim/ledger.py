@@ -70,6 +70,7 @@ class WriteLedger:
         self._violations: List[str] = []          # guarded-by: _lock
         self._losses: List[str] = []              # guarded-by: _lock
         self._reshards: List[ReshardMark] = []    # guarded-by: _lock
+        self._replica_digests: Optional[Dict] = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- recording
@@ -93,6 +94,15 @@ class WriteLedger:
                 course=course, src=src, dst=dst, version=version,
                 at=time.monotonic(),
             ))
+
+    def note_replica_digests(self, doc: Optional[Dict]) -> None:
+        """Record the settle-time cross-replica digest audit (harness
+        `_collect_replica_digests`): per group, every live replica's
+        (applied index, state digest). Divergence here is the runtime
+        face of state-machine nondeterminism — the replicas_converged
+        SLO fails the run on it."""
+        with self._lock:
+            self._replica_digests = doc
 
     def acked_before(self, t0: float, kind: str) -> List[AckedWrite]:
         with self._lock:
@@ -228,4 +238,6 @@ class WriteLedger:
                 # population the final audit certifies as lossless
                 # across the handoff.
                 out["acked_across_reshard"] = crossed
+            if self._replica_digests is not None:
+                out["replica_digests"] = self._replica_digests
             return out

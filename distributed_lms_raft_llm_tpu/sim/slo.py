@@ -523,6 +523,34 @@ def evaluate_slos(
                   f"{groups.get('leaders', {})}"),
             "a leader per Raft group",
         )
+        digests = groups.get("replica_digests") or {}
+        if digests:
+            # The runtime face of the state-machine-determinism lint
+            # rule: at settle, every replica of every group sat at the
+            # same applied index with the same LMSState.digest chain
+            # value — including group members restored mid-run via
+            # InstallSnapshot during the split drill. A divergent digest
+            # means some applier observed clock/RNG/iteration-order
+            # nondeterminism the static rule could not see.
+            diverged = sorted(
+                gid for gid, rows in digests.get("groups", {}).items()
+                if len({r.get("digest") for r in rows.values()}) > 1
+            )
+            check(
+                "replicas_converged", bool(digests.get("converged")),
+                (f"diverged/undrained groups: {diverged}" if diverged
+                 else "digest audit did not converge") if not
+                digests.get("converged") else
+                ", ".join(
+                    f"group {gid}: {len(rows)} replicas @ "
+                    f"{next(iter(rows.values())).get('applied')} = "
+                    f"{next(iter(rows.values())).get('digest')}"
+                    for gid, rows in sorted(
+                        digests.get("groups", {}).items()
+                    )
+                ),
+                "identical per-group state digests at settle",
+            )
         if groups.get("expected_reshard"):
             reshards = groups.get("reshards", [])
             version = int(

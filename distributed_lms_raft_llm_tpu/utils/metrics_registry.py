@@ -611,6 +611,13 @@ RAFT_TICK_STALLS = counter(
     "raft_tick_stalls",
     "Raft ticks later than 10 heartbeat intervals (each also logged)",
 )
+RAFT_STATE_DIGEST = gauge(
+    "raft_state_digest",
+    "low 32 bits of the replica's state-digest chain at its applied "
+    "index (LMSState.digest folded per apply; replicas of one group at "
+    "the same applied index must report the same value — divergence "
+    "here is state-machine nondeterminism)",
+)
 
 # Serving event loop (utils/guards.py LoopWatchdog heartbeat wired by the
 # gRPC server entry points): handler stalls become visible series instead

@@ -3,6 +3,7 @@
 
     python scripts/lint.py                 # whole tree (package+scripts+tests)
     python scripts/lint.py --json          # machine-readable findings
+    python scripts/lint.py --sarif         # SARIF 2.1.0 (CI/editor annotations)
     python scripts/lint.py --rules guarded-by,deadline-flow engine/
     python scripts/lint.py --changed       # only git-changed files (pre-commit)
     python scripts/lint.py --baseline lint-baseline.json   # fail on NEW only
@@ -62,6 +63,7 @@ from distributed_lms_raft_llm_tpu.analysis import (  # noqa: E402
 # annotations; pyproject.toml holds the per-module strictness flags.
 TYPED_SUBSET = [
     "distributed_lms_raft_llm_tpu/raft/core.py",
+    "distributed_lms_raft_llm_tpu/lms/state.py",
     "distributed_lms_raft_llm_tpu/utils/resilience.py",
     "distributed_lms_raft_llm_tpu/utils/guards.py",
     "distributed_lms_raft_llm_tpu/utils/metrics_registry.py",
@@ -117,6 +119,52 @@ def _load_baseline(path: Path) -> List[_BaselineKey]:
     return [_baseline_key(e) for e in entries]
 
 
+def to_sarif(findings, rules) -> Dict[str, object]:
+    """Render the stable dlrl-lint/1 finding set as SARIF 2.1.0 — the
+    interchange shape GitHub code scanning and editors consume, so lint
+    findings surface as PR annotations instead of a CI log to scroll.
+    Mapping: rule -> reportingDescriptor, finding -> result (level
+    "error"; this linter has no warning tier), path/line ->
+    physicalLocation with a repo-relative artifact URI."""
+    by_name = {r.name: r for r in rules}
+    return {
+        "version": "2.1.0",
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dlrl-lint",
+                "rules": [
+                    {
+                        "id": name,
+                        "shortDescription": {
+                            "text": by_name[name].description or name
+                        },
+                    }
+                    for name in sorted(by_name)
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": "error",
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path,
+                                "uriBaseId": "%SRCROOT%",
+                            },
+                            "region": {"startLine": f.line},
+                        }
+                    }],
+                }
+                for f in findings
+            ],
+        }],
+    }
+
+
 def run_type_gate() -> int:
     """The mypy strict-on-subset gate; returns an exit code.
 
@@ -160,6 +208,10 @@ def main(argv=None) -> int:
                              "paths")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the dlrl-lint/1 JSON document")
+    parser.add_argument("--sarif", action="store_true", dest="as_sarif",
+                        help="emit SARIF 2.1.0 (for CI upload / editor "
+                             "annotations); exit status still reflects "
+                             "findings")
     parser.add_argument("--rule", "--rules", action="append", default=None,
                         dest="rules", metavar="RULES",
                         help="run only these rules (comma-separated; "
@@ -199,6 +251,9 @@ def main(argv=None) -> int:
             return 2
         rules = [r for r in rules if r.name in wanted]
 
+    if args.as_json and args.as_sarif:
+        print("--json and --sarif are mutually exclusive", file=sys.stderr)
+        return 2
     paths = [Path(p) for p in args.paths] or None
     nothing_changed = False
     if args.changed:
@@ -248,7 +303,9 @@ def main(argv=None) -> int:
         stale = sorted(known_keys - matched)
         findings = live
 
-    if args.as_json:
+    if args.as_sarif:
+        print(json.dumps(to_sarif(findings, rules), indent=2))
+    elif args.as_json:
         print(json.dumps({
             "schema": "dlrl-lint/1",
             "clean": not findings,

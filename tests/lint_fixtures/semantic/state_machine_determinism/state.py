@@ -1,0 +1,89 @@
+"""Fixture mini-project for the state-machine-determinism rule.
+
+TPs: clock/RNG/env/process-identity reads and unordered set iteration
+reachable from appliers (directly, transitively, and via apply_cb=/
+install_cb= wiring), plus awaited RPC egress on the apply path.
+TNs: pure appliers, sorted() iteration, spawned (ensure_future) work,
+effects in functions no applier reaches, and a sanctioned suppression.
+"""
+
+import asyncio
+import os
+import random
+import time
+import uuid
+
+
+def load_config(path):
+    with open(path) as f:  # TN: not reachable from any applier root
+        return f.read()
+
+
+class State:
+    def __init__(self):
+        self.data = {}
+        self.stub = None
+
+    def apply(self, op, args):
+        handler = getattr(self, f"_apply_{op.lower()}", None)
+        if handler is None:
+            raise ValueError(op)
+        handler(args)
+
+    def _apply_set(self, a):
+        self.data[a["key"]] = a["value"]  # TN: pure
+
+    def _apply_stamp(self, a):
+        self.data["at"] = time.time()  # EXPECT: state-machine-determinism
+
+    def _apply_mint(self, a):
+        self.data["rid"] = uuid.uuid4().hex  # EXPECT: state-machine-determinism
+
+    def _apply_env(self, a):
+        self.data["home"] = os.environ["HOME"]  # EXPECT: state-machine-determinism
+
+    def _apply_indirect(self, a):
+        self._stash_pid(a)
+
+    def _stash_pid(self, a):
+        self.data["pid"] = os.getpid()  # EXPECT: state-machine-determinism
+
+    def _apply_unordered(self, a):
+        moved = {}
+        for user in set(a["users"]):  # EXPECT: state-machine-determinism
+            moved[user] = True
+        self.data["moved"] = moved
+
+    def _apply_sorted(self, a):
+        moved = {}
+        for user in sorted(set(a["users"])):  # TN: sorted() imposes order
+            moved[user] = True
+        self.data["moved"] = moved
+
+    def _apply_spawned(self, a):
+        # TN: replication is SPAWNED off the apply path, never awaited on
+        # the tick loop — the exact idiom LMSNode._apply uses.
+        asyncio.ensure_future(self._push(a))
+
+    async def _push(self, a):
+        await self.stub.Replicate(a, timeout=1.0)
+
+    async def _apply_egress(self, a):
+        await self.stub.Replicate(a)  # EXPECT: state-machine-determinism
+
+    def _apply_sanctioned(self, a):
+        self.data["seed"] = time.time()  # lint: disable=state-machine-determinism (sanctioned: fixture)
+
+
+class Runner:
+    """apply_cb=/install_cb= wiring makes the callbacks rule roots."""
+
+    def __init__(self, raft):
+        self.committed = []
+        raft.configure(apply_cb=self._on_apply, install_cb=self._on_install)
+
+    def _on_apply(self, index, entry):
+        self.committed.append((index, random.random()))  # EXPECT: state-machine-determinism
+
+    def _on_install(self, index, data):
+        self.committed.append((index, time.monotonic()))  # EXPECT: state-machine-determinism

@@ -1,0 +1,81 @@
+"""Fixture mini-project for the wire-taint rule.
+
+TPs: x-lms trust metadata read from raw invocation_metadata (via a dict,
+a generic raw-reader helper, a for-scan, and one forwarding hop), a
+secret compared with ==, and a request field reaching a path sink.
+TNs: reads through the sanctioned verifier, the exempt unsigned hint,
+hmac.compare_digest, a sanitized path hop, and a suppressed probe.
+"""
+
+import hmac
+import os
+
+GROUP_KEY = "x-lms-group"
+USER_KEY = "x-lms-user"
+
+
+def hash_password(password):
+    return "hash:" + password
+
+
+def _signed_md(context):
+    # Sanctioned verifier: raw metadata reads INSIDE it are the point.
+    return dict(context.invocation_metadata() or ())
+
+
+def _metadata_get(context, key):
+    for k, v in context.invocation_metadata() or ():
+        if k == key:
+            return v
+    return None
+
+
+def sanitize_filename(name):
+    return os.path.basename(name)
+
+
+class Router:
+    def good_target(self, context):
+        return _signed_md(context).get(GROUP_KEY)  # TN: via the verifier
+
+    def bad_target(self, context):
+        md = dict(context.invocation_metadata() or ())
+        return md.get(GROUP_KEY)  # EXPECT: wire-taint
+
+    def laundered_target(self, context):
+        return _metadata_get(context, GROUP_KEY)  # EXPECT: wire-taint
+
+    def hint_target(self, context):
+        return _metadata_get(context, USER_KEY)  # TN: unsigned routing hint
+
+    def scanned_target(self, context):
+        for k, v in context.invocation_metadata() or ():
+            if k == GROUP_KEY:  # EXPECT: wire-taint
+                return v
+        return None
+
+    def forwarded_target(self, context):
+        md = dict(context.invocation_metadata() or ())
+        return self._pick(md)
+
+    def _pick(self, md):
+        return md.get(GROUP_KEY)  # EXPECT: wire-taint
+
+    def suppressed_target(self, context):
+        md = dict(context.invocation_metadata() or ())
+        return md.get(GROUP_KEY)  # lint: disable=wire-taint (sanctioned: fixture probe)
+
+    def check_secret(self, stored, presented):
+        return stored == hash_password(presented)  # EXPECT: wire-taint
+
+    def check_secret_safe(self, stored, presented):
+        return hmac.compare_digest(stored, hash_password(presented))  # TN
+
+
+class FileServicer:
+    async def Fetch(self, request, context):
+        return os.path.join("/srv", request.filename)  # EXPECT: wire-taint
+
+    async def FetchSafe(self, request, context):
+        rel = sanitize_filename(request.filename)
+        return os.path.join("/srv", rel)  # TN: sanitized hop

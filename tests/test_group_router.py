@@ -505,7 +505,11 @@ def _fake_lms_node(leader_id, is_leader, term, applied, commit):
         current_term=term, last_applied=applied, commit_index=commit
     )
     node = SimpleNamespace(leader_id=leader_id, is_leader=is_leader, core=core)
-    return SimpleNamespace(node=node, addresses={1: "127.0.0.1:7001"})
+    return SimpleNamespace(
+        node=node, addresses={1: "127.0.0.1:7001"},
+        # The PR-18 digest-chain fields LMSNode maintains per apply.
+        state_digest="00" * 8, _last_applied_index=applied,
+    )
 
 
 def test_groups_admin_topology_shape():
@@ -521,6 +525,8 @@ def test_groups_admin_topology_shape():
     assert row["is_leader"] is False
     assert (row["term"], row["applied"], row["commit"]) == (2, 5, 6)
     assert row["members"] == {"1": "127.0.0.1:7001"}
+    # PR 18: replica digest chain rides the per-group rows.
+    assert (row["digest"], row["digest_applied"]) == ("00" * 8, 5)
 
 
 def test_groups_admin_reshard_refused_without_coordinator():

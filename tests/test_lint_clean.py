@@ -67,6 +67,8 @@ def test_rule_set_covers_the_demonstrated_bug_classes():
         "donation-safety",           # PR-6: use-after-donate
         "dtype-flow",                # PR-6: silent hot-path widening
         "program-inventory",         # PR-6: jit entry points vs manifest
+        "state-machine-determinism",  # PR-18: replica-diverging appliers
+        "wire-taint",                # PR-18: unverified wire input at sinks
     ):
         assert required in names, f"rule {required} missing from the catalog"
 
@@ -486,6 +488,126 @@ def test_uninventoried_jit_entry_fails_lint():
     ]
     assert findings, "a new jit entry point missing from the manifest " \
         "must fail program-inventory"
+
+
+# ------------------------------- reversion pins (effects & taint, PR 18)
+
+
+STATE = "distributed_lms_raft_llm_tpu/lms/state.py"
+
+
+def test_clock_read_in_applier_fails_lint():
+    """PR 18 acceptance pin: a wall-clock read inside a replicated
+    applier (each replica would stamp its OWN time and the state digests
+    diverge) must fail state-machine-determinism. Timestamps are minted
+    leader-side pre-propose and ride the Entry."""
+    from distributed_lms_raft_llm_tpu.analysis.rules \
+        .state_machine_determinism import StateMachineDeterminismRule
+
+    project = _project_with_patch(STATE, (
+        'assignment["grade"] = a["grade"]',
+        'assignment["grade"] = a["grade"]\n'
+        '            assignment["graded_at"] = time.time()',
+    ))
+    findings = [
+        f for f in StateMachineDeterminismRule().check_project(project)
+        if f.path == STATE and "reads-clock" in f.message
+    ]
+    assert findings, (
+        "time.time() in _apply_gradeassignment must fail "
+        "state-machine-determinism"
+    )
+
+
+def test_rng_read_in_applier_fails_lint():
+    """Same class, RNG flavor: minting an id inside an applier gives
+    every replica a different id for the same Entry. Ids come from
+    lms/minting.py BEFORE propose."""
+    from distributed_lms_raft_llm_tpu.analysis.rules \
+        .state_machine_determinism import StateMachineDeterminismRule
+
+    project = _project_with_patch(STATE, (
+        'assignment["grade"] = a["grade"]',
+        'assignment["grade"] = uuid.uuid4().int',
+    ))
+    findings = [
+        f for f in StateMachineDeterminismRule().check_project(project)
+        if f.path == STATE and "reads-rng" in f.message
+    ]
+    assert findings, (
+        "uuid.uuid4() in _apply_gradeassignment must fail "
+        "state-machine-determinism"
+    )
+
+
+def test_unordered_apply_iteration_fails_lint():
+    """PR 18 sweep pin: the _apply_dropkeys bug class — iterating a set
+    while building replicated structure makes insertion order depend on
+    per-process hash randomization. Reverting the dict.fromkeys fix must
+    fail state-machine-determinism."""
+    from distributed_lms_raft_llm_tpu.analysis.rules \
+        .state_machine_determinism import StateMachineDeterminismRule
+
+    project = _project_with_patch(STATE, (
+        'users = list(dict.fromkeys(a["users"]))',
+        'users = set(a["users"])',
+    ))
+    findings = [
+        f for f in StateMachineDeterminismRule().check_project(project)
+        if f.path == STATE and "unordered-iter" in f.message
+    ]
+    assert findings, (
+        "set iteration writing replicated state in _apply_dropkeys must "
+        "fail state-machine-determinism"
+    )
+
+
+def test_unsigned_group_metadata_read_fails_lint():
+    """PR 18 acceptance pin: routing trust decisions read x-lms-group
+    through _signed_md (HMAC-verified). Bypassing the verifier with the
+    raw metadata reader (what reverting PR 16's hardening would do) must
+    fail wire-taint."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.wire_taint import (
+        WireTaintRule,
+    )
+
+    project = _project_with_patch(ROUTER, (
+        "raw = self._signed_md(context).get(GROUP_METADATA_KEY)",
+        "raw = _metadata_get(context, GROUP_METADATA_KEY)",
+    ))
+    findings = [
+        f for f in WireTaintRule().check_project(project)
+        if f.path == ROUTER and "x-lms-group" in f.message
+    ]
+    assert findings, (
+        "reading x-lms-group without _signed_md must fail wire-taint"
+    )
+
+
+def test_secret_equality_compare_fails_lint():
+    """PR 18 sweep pin: password verification uses
+    hmac.compare_digest — reverting to `==` reintroduces the
+    timing-oracle compare and must fail wire-taint."""
+    from distributed_lms_raft_llm_tpu.analysis.rules.wire_taint import (
+        WireTaintRule,
+    )
+
+    project = _project_with_patch(STATE, (
+        'return hmac.compare_digest(\n'
+        '            user["password"], '
+        'hash_password(password, user.get("salt", ""))\n'
+        '        )',
+        'return user["password"] == hash_password('
+        'password, user.get("salt", ""))',
+    ))
+    findings = [
+        f for f in WireTaintRule().check_project(project)
+        if f.path == STATE and "compare_digest" in f.message
+    ]
+    assert findings, (
+        "a == compare against the stored password hash must fail "
+        "wire-taint"
+    )
 
 
 # ------------------------------------------------------ lint wall budget

@@ -67,11 +67,17 @@ from distributed_lms_raft_llm_tpu.analysis.rules.slow_marker import (
     SlowMarkerRule,
     audit,
 )
+from distributed_lms_raft_llm_tpu.analysis.rules.state_machine_determinism import (
+    StateMachineDeterminismRule,
+)
 from distributed_lms_raft_llm_tpu.analysis.rules.trace_propagation import (
     TracePropagationRule,
 )
 from distributed_lms_raft_llm_tpu.analysis.rules.tracer_hygiene import (
     TracerHygieneRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.wire_taint import (
+    WireTaintRule,
 )
 
 REPO = Path(__file__).resolve().parent.parent
@@ -217,6 +223,42 @@ def test_trace_propagation_fixture():
     run_project_rule(
         TracePropagationRule(watch_prefixes=("",)), "trace_propagation"
     )
+
+
+def test_state_machine_determinism_fixture():
+    # Widened to the whole mini-project (the real default scopes to the
+    # package). Pins: direct/transitive/callback-wired roots, the
+    # unordered set-for, awaited egress, and the spawned-work +
+    # sorted() + unreachable-function true negatives.
+    run_project_rule(
+        StateMachineDeterminismRule(watch_prefixes=("",)),
+        "state_machine_determinism",
+    )
+
+
+def test_state_machine_determinism_witness_chain():
+    """Findings carry the root-to-leaf call chain so a transitive leak
+    (applier -> helper -> os.getpid) is actionable at the leaf."""
+    case_dir = SEMANTIC / "state_machine_determinism"
+    sources = [Source(p, root=case_dir)
+               for p in sorted(case_dir.rglob("*.py"))]
+    project = Project(sources, root=case_dir)
+    rule = StateMachineDeterminismRule(watch_prefixes=("",))
+    chained = [f for f in rule.check_project(project)
+               if "_apply_indirect" in f.message]
+    assert chained, "the transitive pid leak must be reported"
+    assert any("_stash_pid" in f.message for f in chained), (
+        "the witness chain must name the helper the effect lives in"
+    )
+
+
+def test_wire_taint_fixture():
+    # Widened to the whole mini-project (the real default scopes to
+    # lms/). Pins: raw-dict read, raw-reader laundering, for-scan, the
+    # one-hop forward, the == secret compare and the request-derived
+    # path sink — plus the verifier/exempt-hint/compare_digest/
+    # sanitizer true negatives.
+    run_project_rule(WireTaintRule(watch_prefixes=("",)), "wire_taint")
 
 
 # ------------------------------------------- abstract interpretation
@@ -441,3 +483,70 @@ def test_cli_rules_selection_and_baseline(tmp_path):
     out = json.loads(fresh.stdout)
     assert not out["clean"] and out["baselined"] == 0
     assert len(out["stale_baseline"]) == 1
+
+
+def test_cli_sarif_round_trips_the_json_findings(tmp_path):
+    """--sarif is the same finding set as --json rendered as SARIF 2.1.0:
+    every (rule, path, line, message) survives the mapping, exit codes
+    still reflect findings, and the two flags are mutually exclusive."""
+    lint = str(REPO / "scripts" / "lint.py")
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        "A = P(None, None)\n"
+        "B = P('x', None)\n"
+    )
+    args = [sys.executable, lint, "--rules", "canonical-pspec", str(bad)]
+    as_json = subprocess.run(
+        args[:2] + ["--json"] + args[2:],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    as_sarif = subprocess.run(
+        args[:2] + ["--sarif"] + args[2:],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert as_json.returncode == 1 and as_sarif.returncode == 1
+
+    doc = json.loads(as_sarif.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "dlrl-lint"
+    assert {r["id"] for r in driver["rules"]} == {"canonical-pspec"}
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+
+    def key(result):
+        (loc,) = result["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uriBaseId"] == "%SRCROOT%"
+        return (
+            result["ruleId"],
+            phys["artifactLocation"]["uri"],
+            phys["region"]["startLine"],
+            result["message"]["text"],
+        )
+
+    sarif_keys = sorted(key(r) for r in run["results"])
+    assert all(r["level"] == "error" for r in run["results"])
+    json_keys = sorted(
+        (f["rule"], f["path"], f["line"], f["message"])
+        for f in json.loads(as_json.stdout)["findings"]
+    )
+    assert sarif_keys == json_keys and len(sarif_keys) == 2
+
+    # A clean scope emits a valid empty run and exits 0.
+    clean = subprocess.run(
+        [sys.executable, lint, "--rules", "canonical-pspec", "--sarif",
+         str(REPO / "scripts")],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert json.loads(clean.stdout)["runs"][0]["results"] == []
+
+    both = subprocess.run(
+        args[:2] + ["--json", "--sarif"] + args[2:],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert both.returncode == 2
+    assert "mutually exclusive" in both.stderr

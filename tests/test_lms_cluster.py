@@ -221,6 +221,147 @@ def test_uploaded_files_replicated_to_followers(cluster, client):
     assert all(present), present
 
 
+def test_replica_state_digests_converge(cluster, client):
+    """PR 18: every replica folds LMSState.digest() into a per-applied-
+    index digest chain; at quiescence all three replicas of the group
+    must sit at the same applied index with the SAME digest — the
+    runtime half of the state-machine-determinism rule.
+
+    (Runs before the failover test below, which stops the leader.)"""
+    import time
+
+    deadline = time.monotonic() + 10.0
+    nodes = list(cluster["nodes"].values())
+    while time.monotonic() < deadline:
+        applied = {n._last_applied_index for n in nodes}
+        digests = {n.state_digest for n in nodes}
+        if len(applied) == 1 and len(digests) == 1:
+            break
+        time.sleep(0.1)
+    assert len(applied) == 1, f"applied indexes diverged: {applied}"
+    assert len(digests) == 1, (
+        "replicas diverged at the same applied index — "
+        f"nondeterministic apply: {digests}"
+    )
+    (digest,) = digests
+    assert len(digest) == 16 and int(digest, 16) >= 0
+    # The chain is a pure fold of (index, state): recomputing on each
+    # node reproduces the live value, and raw state digests agree too.
+    for n in nodes:
+        assert n._fold_digest(n._last_applied_index) == digest
+    assert len({n.state.digest() for n in nodes}) == 1
+
+
+def test_digest_chain_survives_restart_and_snapshot_install(tmp_path):
+    """PR 18: the digest is a pure function of (applied index, state) —
+    NOT an in-memory running hash — so a node restarted from its own
+    WAL+snapshot, and a wiped node rejoining via InstallSnapshot, both
+    land back on the exact chain value their peers report."""
+    from distributed_lms_raft_llm_tpu.lms.node import LMSNode as _LMSNode
+    from distributed_lms_raft_llm_tpu.raft.messages import encode_command
+
+    async def run():
+        ids = [1, 2, 3]
+        servers, addresses, ports = {}, {}, {}
+        for i in ids:
+            servers[i] = grpc.aio.server()
+            ports[i] = servers[i].add_insecure_port("127.0.0.1:0")
+            addresses[i] = f"127.0.0.1:{ports[i]}"
+        nodes = {}
+
+        async def boot(i, dirname):
+            node = _LMSNode(i, addresses, str(tmp_path / dirname),
+                            raft_config=FAST, snapshot_every=5)
+            rpc.add_RaftServiceServicer_to_server(
+                RaftServicer(node.node, addresses), servers[i]
+            )
+            await servers[i].start()
+            await node.start()
+            nodes[i] = node
+
+        async def reboot_server(i):
+            servers[i] = grpc.aio.server()
+            bound = servers[i].add_insecure_port(f"127.0.0.1:{ports[i]}")
+            assert bound == ports[i], "could not rebind node port"
+
+        async def converged_digest(expect_members=3):
+            """Wait for one (applied, digest) across all live nodes."""
+            for _ in range(500):
+                live = list(nodes.values())
+                applied = {n._last_applied_index for n in live}
+                digests = {n.state_digest for n in live}
+                if (len(live) == expect_members and len(applied) == 1
+                        and len(digests) == 1):
+                    return applied.pop(), digests.pop()
+                await asyncio.sleep(0.02)
+            raise AssertionError(
+                f"no digest convergence: applied={applied} digests={digests}"
+            )
+
+        for i in ids:
+            await boot(i, f"node{i}")
+        try:
+            leader = None
+            for _ in range(300):
+                leaders = [n for n in nodes.values() if n.node.is_leader]
+                if leaders:
+                    leader = leaders[0]
+                    break
+                await asyncio.sleep(0.02)
+            assert leader is not None
+
+            async def register(k):
+                await leader.node.propose(encode_command(
+                    "Register",
+                    {"username": f"user{k}", "password_hash": "h",
+                     "salt": "", "role": "student"},
+                ))
+
+            # Past the snapshot cadence (5) so restarts replay from a
+            # snapshot + WAL suffix, not a fresh log.
+            for k in range(12):
+                await register(k)
+            applied0, digest0 = await converged_digest()
+
+            # -- restart a follower from its own data dir ------------------
+            victim = next(i for i in ids if not nodes[i].node.is_leader)
+            await nodes[victim].stop()
+            await servers[victim].stop(None)
+            del nodes[victim]
+            await reboot_server(victim)
+            await boot(victim, f"node{victim}")  # SAME dir: snapshot+WAL
+            applied1, digest1 = await converged_digest()
+            assert applied1 == applied0 and digest1 == digest0, (
+                "restart-from-snapshot left the digest chain"
+            )
+
+            # -- wipe a follower; rejoin via InstallSnapshot ---------------
+            victim2 = next(
+                i for i in ids
+                if i != victim and not nodes[i].node.is_leader
+            )
+            await nodes[victim2].stop()
+            await servers[victim2].stop(None)
+            del nodes[victim2]
+            for k in range(12, 15):  # commits while it is down
+                await register(k)
+            await reboot_server(victim2)
+            await boot(victim2, f"node{victim2}-wiped")  # EMPTY dir
+            applied2, digest2 = await converged_digest()
+            assert applied2 > applied0
+            assert digest2 != digest0  # state moved on; chain did too
+            # The rejoiner really came through snapshot install.
+            assert nodes[victim2].node.core.snapshot_index >= 5
+            assert len(nodes[victim2].state.data["users"]) == 15
+        finally:
+            for n in nodes.values():
+                await n.stop()
+            for s in servers.values():
+                await s.stop(None)
+
+    asyncio.run(run())
+
+
 def test_sessions_survive_failover(cluster, client):
     """The D7 fix: a login taken before leader failure works after it."""
 

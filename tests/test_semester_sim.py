@@ -456,6 +456,25 @@ def test_sharded_sim_bench_record_fields(groups_run):
     assert record["groups"]["expected_reshard"] is True
 
 
+def test_sharded_sim_replicas_converged(groups_run):
+    """PR 18 acceptance: at settle — AFTER the mid-peak group_split
+    drill — every group's surviving replicas sit at one applied index
+    with one state digest (the raft_state_digest chain), and the SLO
+    layer turns that evidence into a `replicas_converged` verdict."""
+    record, _ = groups_run
+    check = record["slos"]["checks"]["replicas_converged"]
+    assert check["ok"], check
+    digests = record["groups"]["replica_digests"]
+    assert digests["converged"] is True
+    assert set(digests["groups"]) == {"0", "1"}
+    for gid, rows in digests["groups"].items():
+        assert len(rows) >= 2, f"group {gid} audited <2 replicas: {rows}"
+        assert len({r["digest"] for r in rows.values()}) == 1, rows
+        assert len({r["applied"] for r in rows.values()}) == 1, rows
+        for r in rows.values():
+            assert isinstance(r["digest"], str) and len(r["digest"]) == 16
+
+
 def test_sharded_sim_wall_budget(groups_run):
     """CI guard: the sharded tier-1 sim must stay inside its time box."""
     _, wall = groups_run
