@@ -1,0 +1,1145 @@
+"""Interprocedural concurrency model: suspension points + locksets.
+
+PR 4's project model answers "who calls whom", PR 18's effect engine
+answers "what does a call do"; this module answers the two questions the
+interleaving-bug class needs:
+
+- **where can this function suspend?** Every ``await``, ``async with``
+  and ``async for`` is a *suspension point* — except that awaiting a
+  project-local coroutine which itself never suspends does NOT yield to
+  the event loop (CPython runs it to completion synchronously), so the
+  model resolves awaited project calls through the call graph and closes
+  ``may_suspend`` to a fixpoint. Rules built on it can therefore tell a
+  real interleaving window from an await that is structurally atomic.
+
+- **which locks can this call path hold/acquire?** ``threading.Lock`` /
+  ``threading.RLock`` / ``asyncio.Lock`` creations are collected into a
+  lock table keyed by declaration site (``<rel>::Class.attr`` — one key
+  per *declaration*, so two instances of the same class share a key,
+  which is exactly the granularity that catches PR 13's two-breaker
+  self-deadlock). Acquisitions via ``with`` / ``async with`` /
+  ``.acquire()`` are tracked with the held-set at each event, closed
+  transitively over call edges, and every cross-lock acquisition becomes
+  an edge in a global lock-acquisition **order graph** with witness
+  chains like ``effects.py``.
+
+To resolve attribute-chain calls (``node.breaker.state_code()``) the
+engine layers a deliberately small type inference over the project
+model: class attribute types from ``self.x = Ctor()`` / parameter
+annotations, parameter types from annotations (``Optional[...]``
+unwrapped), and local variable types from constructor assignments.
+``@property`` loads whose receiver type is known contribute call edges
+too — a property that takes a lock (``CircuitBreaker.state``) is a call
+in every sense that matters here.
+
+Callback linkage (the PR-13 shape): calls through unresolvable callables
+(a parameter, a ``self._cb`` field) made while holding a lock are
+recorded as *dynamic call sites*; functions/lambdas passed to
+``set_*_callback`` / ``add_*_callback``-style registrars (or
+``callback=`` / ``on_*=`` keywords) are recorded as *registered
+callbacks*. A registered callback whose transitive lockset intersects a
+dynamic site's held locks is the single-thread self-deadlock that froze
+the serving loop in PR 13. Callback-derived edges also enter the order
+graph (tagged), so the runtime ``utils/locks.py`` graph can be checked
+for consistency against the static one.
+
+Entry-held convention: a method whose ``def`` line carries
+``# guarded-by: <attr>`` (the PR-6 annotation, attr naming a lock of the
+same class) is analyzed with that lock in its entry held-set — callers
+hold it, so suspensions/acquisitions inside are events under the lock.
+
+Like the project/effect models this is unsound-by-design: unresolved
+dynamic dispatch contributes no edge and lexical position stands in for
+program order, so rules lose findings rather than invent them — except
+the callback linkage above, which is deliberately conservative (any
+registered callback may run at any dynamic site) because that is the
+direction the deadlock class demands.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import weakref
+from typing import (
+    Dict,
+    FrozenSet,
+    Iterable,
+    List,
+    MutableMapping,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
+
+from .effects import _classify_call, BLOCKING, _SPAWN_WRAPPERS
+from .project import FunctionInfo, ModuleInfo, Project, _dotted
+
+__all__ = [
+    "KIND_THREADING",
+    "KIND_ASYNCIO",
+    "LockInfo",
+    "Suspension",
+    "Acquisition",
+    "CallEvent",
+    "BlockingEvent",
+    "DynamicCall",
+    "OrderEdge",
+    "LockWitness",
+    "ConcurrencyEngine",
+    "concurrency_engine",
+]
+
+KIND_THREADING = "threading"
+KIND_ASYNCIO = "asyncio"
+
+# Same annotation grammar as rules/guarded_by.py (kept local: rule
+# modules import this engine, so the engine cannot import the rules
+# package without a cycle).
+_ANNOT_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w\-]*)")
+
+# Constructor spellings -> (kind, reentrant). `make_lock`/`OrderedLock`
+# are the utils/locks.py runtime counterpart: debug wrappers around
+# threading locks, so they inherit threading semantics.
+_LOCK_CTORS: Dict[str, Tuple[str, bool]] = {
+    "threading.Lock": (KIND_THREADING, False),
+    "threading.RLock": (KIND_THREADING, True),
+    "asyncio.Lock": (KIND_ASYNCIO, False),
+    "make_lock": (KIND_THREADING, False),
+    "locks.make_lock": (KIND_THREADING, False),
+    "OrderedLock": (KIND_THREADING, False),
+    "locks.OrderedLock": (KIND_THREADING, False),
+}
+_BARE_LOCK_IMPORTS = {
+    ("threading", "Lock"): (KIND_THREADING, False),
+    ("threading", "RLock"): (KIND_THREADING, True),
+    ("asyncio", "Lock"): (KIND_ASYNCIO, False),
+}
+
+# Call names that register a callable to be invoked later by the callee
+# (`set_state_change_callback`, `add_done_listener`, ...) and keyword
+# names that carry one.
+_REGISTRAR_RE = re.compile(
+    r"^(set|add|register|on)_.*(callback|listener|hook)s?$"
+)
+_CALLBACK_KWARG_RE = re.compile(r"(^on_)|callback|_cb$|^cb$|_hook$")
+
+_PROPERTY_DECOS = {"property", "cached_property", "functools.cached_property"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockInfo:
+    """One lock *declaration* (all instances share the key)."""
+
+    key: str        # "<rel>::Class.attr" or "<rel>::name"
+    short: str      # "Class.attr" or "name" — the runtime-visible name
+    kind: str       # KIND_THREADING | KIND_ASYNCIO
+    reentrant: bool
+    rel: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Suspension:
+    """One potential yield-to-event-loop point inside a function.
+
+    ``callee`` is set when the suspension is an awaited project-local
+    call: it only actually suspends when the callee's ``may_suspend``
+    closes to True (`ConcurrencyEngine.true_suspensions` applies that)."""
+
+    rel: str
+    line: int
+    col: int
+    detail: str
+    held: FrozenSet[str]
+    callee: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Acquisition:
+    lock: str
+    rel: str
+    line: int
+    held: FrozenSet[str]   # held BEFORE this acquisition
+    via: str               # "with" | "async with" | "acquire()"
+
+
+@dataclasses.dataclass(frozen=True)
+class CallEvent:
+    callee: str
+    rel: str
+    line: int
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockingEvent:
+    """An unresolved blocking intrinsic (PR-18 lattice) at a call site."""
+
+    rel: str
+    line: int
+    detail: str
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicCall:
+    """A call through an unresolvable callable while holding locks."""
+
+    rel: str
+    line: int
+    detail: str
+    held: FrozenSet[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderEdge:
+    """`dst` acquired (possibly transitively) while `src` is held."""
+
+    src: str
+    dst: str
+    qname: str   # function containing the event that created the edge
+    rel: str
+    line: int
+    via: str     # "with"/"acquire()" | "call" | "callback"
+
+
+@dataclasses.dataclass(frozen=True)
+class LockWitness:
+    """Call chain from a root function down to the acquisition site."""
+
+    chain: Tuple[str, ...]
+    site: Acquisition
+
+    def pretty(self, short: str) -> str:
+        names = [q.split("::", 1)[-1] for q in self.chain]
+        return " -> ".join(names + [f"acquire {short}"])
+
+
+def _line_annotation(src_lines: Sequence[str], lineno: int) -> Optional[str]:
+    if 1 <= lineno <= len(src_lines):
+        m = _ANNOT_RE.search(src_lines[lineno - 1])
+        if m:
+            return m.group(1)
+    if lineno >= 2:
+        above = src_lines[lineno - 2].strip()
+        if above.startswith("#"):
+            m = _ANNOT_RE.search(above)
+            if m:
+                return m.group(1)
+    return None
+
+
+def _last(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassFacts:
+    """Per-class lock declarations, attribute types, and lock guards."""
+
+    def __init__(self) -> None:
+        self.lock_attrs: Dict[str, str] = {}    # attr -> lock key
+        self.attr_types: Dict[str, str] = {}    # attr -> class key
+
+
+class ConcurrencyEngine:
+    """Suspension model + interprocedural lockset analysis."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.locks: Dict[str, LockInfo] = {}
+        self._class_facts: Dict[str, _ClassFacts] = {}
+        self._module_locks: Dict[str, Dict[str, str]] = {}
+        self._bare_lock_names: Dict[str, Dict[str, Tuple[str, bool]]] = {}
+        self._suspensions: Dict[str, List[Suspension]] = {}
+        self._acquisitions: Dict[str, List[Acquisition]] = {}
+        self._calls: Dict[str, List[CallEvent]] = {}
+        self._blocking: Dict[str, List[BlockingEvent]] = {}
+        self._dynamic: Dict[str, List[DynamicCall]] = {}
+        self._entry_held: Dict[str, FrozenSet[str]] = {}
+        self._may_suspend: Dict[str, bool] = {}
+        self._locksets: Dict[str, Set[str]] = {}
+        self._registered: Dict[str, Tuple[str, int]] = {}  # qname -> site
+        self._collect_locks_and_types()
+        self._scan_functions()
+        self._close_may_suspend()
+        self._close_locksets()
+        self._edges = self._build_order_edges()
+
+    # -------------------------------------------------- pass 1: lock table
+
+    def _bare_locks(self, mod: ModuleInfo) -> Dict[str, Tuple[str, bool]]:
+        cached = self._bare_lock_names.get(mod.rel)
+        if cached is not None:
+            return cached
+        out: Dict[str, Tuple[str, bool]] = {}
+        for node in ast.walk(mod.src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module in (
+                "threading", "asyncio",
+            ):
+                for alias in node.names:
+                    hit = _BARE_LOCK_IMPORTS.get((node.module, alias.name))
+                    if hit is not None:
+                        out[alias.asname or alias.name] = hit
+        self._bare_lock_names[mod.rel] = out
+        return out
+
+    def _lock_ctor(
+        self, mod: ModuleInfo, expr: ast.expr
+    ) -> Optional[Tuple[str, bool]]:
+        """(kind, reentrant) when `expr` constructs a lock, else None."""
+        if isinstance(expr, ast.BoolOp):
+            for value in expr.values:
+                hit = self._lock_ctor(mod, value)
+                if hit is not None:
+                    return hit
+            return None
+        if not isinstance(expr, ast.Call):
+            return None
+        dotted = _dotted(expr.func)
+        hit = _LOCK_CTORS.get(dotted)
+        if hit is None and isinstance(expr.func, ast.Name):
+            hit = self._bare_locks(mod).get(expr.func.id)
+        if hit is None:
+            return None
+        kind, reentrant = hit
+        for kw in expr.keywords:
+            if kw.arg == "reentrant" and isinstance(kw.value, ast.Constant):
+                reentrant = bool(kw.value.value)
+        return (kind, reentrant)
+
+    def _resolve_class_key(
+        self, mod: ModuleInfo, name: str
+    ) -> Optional[str]:
+        if name in mod.classes:
+            return f"{mod.rel}::{name}"
+        imp = mod.imports.get(name)
+        if imp is not None and imp[0] == "sym":
+            key = f"{imp[1]}::{imp[2]}"
+            if key in self.project.classes:
+                return key
+        return None
+
+    def _ann_class(
+        self, mod: ModuleInfo, ann: Optional[ast.expr]
+    ) -> Optional[str]:
+        """Class key an annotation denotes; Optional[...] unwrapped."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.strip()
+            if name.isidentifier():
+                return self._resolve_class_key(mod, name)
+            return None
+        if isinstance(ann, ast.Name):
+            return self._resolve_class_key(mod, ann.id)
+        if isinstance(ann, ast.Attribute):
+            dotted = _dotted(ann)
+            head, _, tail = dotted.partition(".")
+            imp = mod.imports.get(head)
+            if imp is not None and imp[0] == "mod" and "." not in tail:
+                key = f"{imp[1]}::{tail}"
+                if key in self.project.classes:
+                    return key
+            return None
+        if isinstance(ann, ast.Subscript):
+            base = _last(_dotted(ann.value))
+            if base == "Optional":
+                return self._ann_class(mod, ann.slice)
+            if base == "Union" and isinstance(ann.slice, ast.Tuple):
+                for elt in ann.slice.elts:
+                    hit = self._ann_class(mod, elt)
+                    if hit is not None:
+                        return hit
+        return None
+
+    def _ctor_class(
+        self, mod: ModuleInfo, expr: ast.expr
+    ) -> Optional[str]:
+        """Class key when `expr` constructs a project-local class."""
+        if isinstance(expr, ast.BoolOp):
+            for value in expr.values:
+                hit = self._ctor_class(mod, value)
+                if hit is not None:
+                    return hit
+            return None
+        if not isinstance(expr, ast.Call):
+            return None
+        func = expr.func
+        if isinstance(func, ast.Name):
+            return self._resolve_class_key(mod, func.id)
+        if isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ):
+            imp = mod.imports.get(func.value.id)
+            if imp is not None and imp[0] == "mod":
+                key = f"{imp[1]}::{func.attr}"
+                if key in self.project.classes:
+                    return key
+        return None
+
+    def _param_types(self, fn: FunctionInfo) -> Dict[str, str]:
+        mod = self.project.modules[fn.rel]
+        node = fn.node
+        out: Dict[str, str] = {}
+        args = getattr(node, "args", None)
+        if args is None:
+            return out
+        for arg in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        ):
+            key = self._ann_class(mod, arg.annotation)
+            if key is not None:
+                out[arg.arg] = key
+        return out
+
+    def _collect_locks_and_types(self) -> None:
+        for class_key, cls in self.project.classes.items():
+            facts = _ClassFacts()
+            self._class_facts[class_key] = facts
+            mod = self.project.modules[cls.rel]
+            for method in cls.methods.values():
+                params = self._param_types(method)
+                for node in ast.walk(method.node):
+                    if isinstance(node, ast.AnnAssign):
+                        attr = _self_attr(node.target)
+                        if attr is None:
+                            continue
+                        hit = self._ann_class(mod, node.annotation)
+                        if hit is not None:
+                            facts.attr_types.setdefault(attr, hit)
+                        if node.value is not None:
+                            self._note_attr_assign(
+                                mod, cls.name, facts, attr,
+                                node.value, node.lineno, params,
+                            )
+                    elif isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                self._note_attr_assign(
+                                    mod, cls.name, facts, attr,
+                                    node.value, node.lineno, params,
+                                )
+        for rel, mod in self.project.modules.items():
+            table: Dict[str, str] = {}
+            for stmt in mod.src.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                hit = self._lock_ctor(mod, stmt.value)
+                if hit is None:
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        key = f"{rel}::{t.id}"
+                        table[t.id] = key
+                        self.locks.setdefault(key, LockInfo(
+                            key=key, short=t.id, kind=hit[0],
+                            reentrant=hit[1], rel=rel, line=stmt.lineno,
+                        ))
+            self._module_locks[rel] = table
+
+    def _note_attr_assign(
+        self,
+        mod: ModuleInfo,
+        class_name: str,
+        facts: _ClassFacts,
+        attr: str,
+        value: ast.expr,
+        lineno: int,
+        params: Dict[str, str],
+    ) -> None:
+        lock = self._lock_ctor(mod, value)
+        if lock is not None:
+            key = f"{mod.rel}::{class_name}.{attr}"
+            facts.lock_attrs.setdefault(attr, key)
+            self.locks.setdefault(key, LockInfo(
+                key=key, short=f"{class_name}.{attr}", kind=lock[0],
+                reentrant=lock[1], rel=mod.rel, line=lineno,
+            ))
+            return
+        hit = self._ctor_class(mod, value)
+        if hit is None and isinstance(value, ast.Name):
+            hit = params.get(value.id)
+        if hit is None and isinstance(value, ast.BoolOp):
+            for v in value.values:
+                if isinstance(v, ast.Name) and v.id in params:
+                    hit = params[v.id]
+                    break
+        if hit is not None:
+            facts.attr_types.setdefault(attr, hit)
+
+    # ------------------------------------------------- pass 2: function scan
+
+    def _class_key_of(self, fn: FunctionInfo) -> Optional[str]:
+        if fn.class_name is None:
+            return None
+        key = f"{fn.rel}::{fn.class_name}"
+        return key if key in self.project.classes else None
+
+    def _scan_functions(self) -> None:
+        for qname, fn in self.project.functions.items():
+            scan = _FnScan(self, fn)
+            self._suspensions[qname] = scan.suspensions
+            self._acquisitions[qname] = scan.acquisitions
+            self._calls[qname] = scan.calls
+            self._blocking[qname] = scan.blocking
+            self._dynamic[qname] = scan.dynamic_calls
+            self._entry_held[qname] = scan.entry_held
+            for cb, site in scan.registered.items():
+                self._registered.setdefault(cb, site)
+
+    # ----------------------------------------------------- pass 3: closures
+
+    def _close_may_suspend(self) -> None:
+        for qname, fn in self.project.functions.items():
+            self._may_suspend[qname] = fn.is_async and any(
+                s.callee is None for s in self._suspensions[qname]
+            )
+        changed = True
+        while changed:
+            changed = False
+            for qname, fn in self.project.functions.items():
+                if self._may_suspend[qname] or not fn.is_async:
+                    continue
+                for s in self._suspensions[qname]:
+                    if s.callee is not None and self._may_suspend.get(
+                        s.callee, False
+                    ):
+                        self._may_suspend[qname] = True
+                        changed = True
+                        break
+
+    def _close_locksets(self) -> None:
+        for qname in self.project.functions:
+            self._locksets[qname] = {
+                a.lock for a in self._acquisitions[qname]
+            }
+        changed = True
+        while changed:
+            changed = False
+            for qname in self.project.functions:
+                mine = self._locksets[qname]
+                before = len(mine)
+                for call in self._calls[qname]:
+                    callee = self._locksets.get(call.callee)
+                    if callee:
+                        mine |= callee
+                if len(mine) != before:
+                    changed = True
+
+    # ------------------------------------------------ pass 4: order graph
+
+    def _build_order_edges(self) -> Dict[Tuple[str, str], OrderEdge]:
+        edges: Dict[Tuple[str, str], OrderEdge] = {}
+
+        def add(src: str, dst: str, qname: str, rel: str, line: int,
+                via: str) -> None:
+            if src == dst:
+                return
+            edges.setdefault((src, dst), OrderEdge(
+                src=src, dst=dst, qname=qname, rel=rel, line=line, via=via,
+            ))
+
+        for qname in self.project.functions:
+            for acq in self._acquisitions[qname]:
+                for held in acq.held:
+                    add(held, acq.lock, qname, acq.rel, acq.line, acq.via)
+            for call in self._calls[qname]:
+                if not call.held:
+                    continue
+                for dst in self._locksets.get(call.callee, ()):
+                    if dst in call.held:
+                        continue
+                    for src in call.held:
+                        add(src, dst, qname, call.rel, call.line, "call")
+            for dyn in self._dynamic[qname]:
+                for cb in self._registered:
+                    for dst in self._locksets.get(cb, ()):
+                        if dst in dyn.held:
+                            continue
+                        for src in dyn.held:
+                            add(src, dst, qname, dyn.rel, dyn.line,
+                                "callback")
+        return edges
+
+    # ----------------------------------------------------------- queries
+
+    def suspensions(self, qname: str) -> List[Suspension]:
+        return list(self._suspensions.get(qname, ()))
+
+    def may_suspend(self, qname: str) -> bool:
+        return self._may_suspend.get(qname, False)
+
+    def true_suspensions(self, qname: str) -> List[Suspension]:
+        """Suspension events that can actually yield to the event loop."""
+        return [
+            s for s in self._suspensions.get(qname, ())
+            if s.callee is None or self._may_suspend.get(s.callee, False)
+        ]
+
+    def acquisitions(self, qname: str) -> List[Acquisition]:
+        return list(self._acquisitions.get(qname, ()))
+
+    def calls(self, qname: str) -> List[CallEvent]:
+        return list(self._calls.get(qname, ()))
+
+    def blocking_events(self, qname: str) -> List[BlockingEvent]:
+        return list(self._blocking.get(qname, ()))
+
+    def dynamic_calls(self, qname: str) -> List[DynamicCall]:
+        return list(self._dynamic.get(qname, ()))
+
+    def entry_held(self, qname: str) -> FrozenSet[str]:
+        return self._entry_held.get(qname, frozenset())
+
+    def lockset(self, qname: str) -> FrozenSet[str]:
+        """Locks `qname` may acquire, transitively over call edges."""
+        return frozenset(self._locksets.get(qname, ()))
+
+    def registered_callbacks(self) -> Dict[str, Tuple[str, int]]:
+        """qname -> (rel, line) of one registration site."""
+        return dict(self._registered)
+
+    def order_edges(self) -> Dict[Tuple[str, str], OrderEdge]:
+        return dict(self._edges)
+
+    def static_order_shorts(self) -> Set[Tuple[str, str]]:
+        """Order edges on runtime-visible lock names, for cross-validation
+        against the live graph `utils/locks.py` records in debug mode."""
+        out: Set[Tuple[str, str]] = set()
+        for (src, dst) in self._edges:
+            a, b = self.locks.get(src), self.locks.get(dst)
+            if a is not None and b is not None:
+                out.add((a.short, b.short))
+        return out
+
+    def held_threading(self, held: Iterable[str]) -> List[str]:
+        return sorted(
+            k for k in held
+            if self.locks.get(k) is not None
+            and self.locks[k].kind == KIND_THREADING
+        )
+
+    def short(self, key: str) -> str:
+        info = self.locks.get(key)
+        return info.short if info is not None else key
+
+    def lock_witness(
+        self, root: str, lock: str
+    ) -> Optional[LockWitness]:
+        """Shortest call chain from `root` to an acquisition of `lock`
+        (BFS, sorted neighbors — deterministic like effects.witness)."""
+        if lock not in self._locksets.get(root, ()):
+            return None
+        parent: Dict[str, Optional[str]] = {root: None}
+        queue: List[str] = [root]
+        while queue:
+            cur = queue.pop(0)
+            for acq in self._acquisitions.get(cur, ()):
+                if acq.lock == lock:
+                    chain: List[str] = []
+                    walk: Optional[str] = cur
+                    while walk is not None:
+                        chain.append(walk)
+                        walk = parent[walk]
+                    return LockWitness(tuple(reversed(chain)), acq)
+            for call in sorted(
+                self._calls.get(cur, ()), key=lambda c: c.callee
+            ):
+                nxt = call.callee
+                if nxt not in parent and lock in self._locksets.get(nxt, ()):
+                    parent[nxt] = cur
+                    queue.append(nxt)
+        return None
+
+    def cycles(self) -> List[List[str]]:
+        """Strongly connected components of the order graph with >= 2
+        locks — each is a potential deadlock cycle. Deterministic order."""
+        adj: Dict[str, List[str]] = {}
+        for (src, dst) in self._edges:
+            adj.setdefault(src, []).append(dst)
+            adj.setdefault(dst, [])
+        for outs in adj.values():
+            outs.sort()
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        sccs: List[List[str]] = []
+
+        def strongconnect(v: str) -> None:
+            # Iterative Tarjan: (node, iterator-position) frames.
+            work: List[Tuple[str, int]] = [(v, 0)]
+            while work:
+                node, pos = work.pop()
+                if pos == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                recurse = False
+                outs = adj.get(node, [])
+                for i in range(pos, len(outs)):
+                    w = outs[i]
+                    if w not in index:
+                        work.append((node, i + 1))
+                        work.append((w, 0))
+                        recurse = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    comp: List[str] = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) >= 2:
+                        sccs.append(sorted(comp))
+                if work:
+                    parent_node = work[-1][0]
+                    low[parent_node] = min(low[parent_node], low[node])
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        return sorted(sccs)
+
+
+class _FnScan:
+    """One ordered pass over a function body: suspension points,
+    acquisitions (with held-sets), resolved/typed call events, dynamic
+    call sites, blocking intrinsics, and callback registrations."""
+
+    def __init__(self, engine: ConcurrencyEngine, fn: FunctionInfo):
+        self.engine = engine
+        self.fn = fn
+        self.mod = engine.project.modules[fn.rel]
+        self.class_key = engine._class_key_of(fn)
+        self.params = engine._param_types(fn)
+        self.local_types: Dict[str, str] = {}
+        self.local_names: Set[str] = set(self.params)
+        self.suspensions: List[Suspension] = []
+        self.acquisitions: List[Acquisition] = []
+        self.calls: List[CallEvent] = []
+        self.blocking: List[BlockingEvent] = []
+        self.dynamic_calls: List[DynamicCall] = []
+        self.registered: Dict[str, Tuple[str, int]] = {}
+        args = getattr(fn.node, "args", None)
+        if args is not None:
+            for arg in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+            ):
+                self.local_names.add(arg.arg)
+        self.entry_held = self._entry_held()
+        self._held: Set[str] = set(self.entry_held)
+        self._seen_calls: Set[Tuple[int, str]] = set()
+        for stmt in getattr(fn.node, "body", []):
+            self._stmt(stmt)
+
+    # ------------------------------------------------------------ helpers
+
+    def _entry_held(self) -> FrozenSet[str]:
+        """`# guarded-by: <lock-attr>` on the def line = callers hold it."""
+        guard = _line_annotation(self.fn.src.lines, self.fn.node.lineno)
+        if guard is None or self.class_key is None:
+            return frozenset()
+        facts = self.engine._class_facts.get(self.class_key)
+        if facts is None:
+            return frozenset()
+        key = facts.lock_attrs.get(guard)
+        return frozenset((key,)) if key is not None else frozenset()
+
+    def _expr_class(self, expr: ast.expr) -> Optional[str]:
+        """Class key an expression's value has, when inference can see it."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return self.class_key
+            return self.local_types.get(expr.id) or self.params.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_class(expr.value)
+            if base is None:
+                return None
+            facts = self.engine._class_facts.get(base)
+            if facts is None:
+                return None
+            return facts.attr_types.get(expr.attr)
+        if isinstance(expr, ast.Call):
+            return self.engine._ctor_class(self.mod, expr)
+        return None
+
+    def _lock_key(self, expr: ast.expr) -> Optional[str]:
+        """Lock-table key an expression denotes, else None."""
+        if isinstance(expr, ast.Name):
+            return self.engine._module_locks.get(self.mod.rel, {}).get(
+                expr.id
+            )
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_class(expr.value)
+            if base is not None:
+                facts = self.engine._class_facts.get(base)
+                if facts is not None:
+                    key = facts.lock_attrs.get(expr.attr)
+                    if key is not None:
+                        return key
+            if isinstance(expr.value, ast.Name) and expr.value.id != "self":
+                # module-level lock referenced through an import alias
+                imp = self.mod.imports.get(expr.value.id)
+                if imp is not None and imp[0] == "mod":
+                    return self.engine._module_locks.get(imp[1], {}).get(
+                        expr.attr
+                    )
+        return None
+
+    def _resolve(self, call: ast.Call) -> Optional[FunctionInfo]:
+        """Project heuristic resolution, then the typed-chain fallback."""
+        callee = self.engine.project.resolve_call(
+            self.mod, call.func, self.fn.class_name, self.fn
+        )
+        if callee is not None:
+            return callee
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            base = self._expr_class(func.value)
+            if base is not None:
+                cls = self.engine.project.classes[base]
+                owner = self.engine.project.modules[cls.rel]
+                return self.engine.project._lookup_method(
+                    owner, cls.name, func.attr
+                )
+        return None
+
+    def _property_target(
+        self, node: ast.Attribute
+    ) -> Optional[FunctionInfo]:
+        base = self._expr_class(node.value)
+        if base is None:
+            return None
+        cls = self.engine.project.classes[base]
+        owner = self.engine.project.modules[cls.rel]
+        target = self.engine.project._lookup_method(
+            owner, cls.name, node.attr
+        )
+        if target is None:
+            return None
+        for deco in getattr(target.node, "decorator_list", []):
+            if _dotted(deco) in _PROPERTY_DECOS:
+                return target
+        return None
+
+    def _held_snapshot(self) -> FrozenSet[str]:
+        return frozenset(self._held)
+
+    def _suspend(
+        self, node: ast.AST, detail: str, callee: Optional[str] = None
+    ) -> None:
+        self.suspensions.append(Suspension(
+            rel=self.fn.rel,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            detail=detail,
+            held=self._held_snapshot(),
+            callee=callee,
+        ))
+
+    def _acquire(self, key: str, node: ast.AST, via: str) -> None:
+        self.acquisitions.append(Acquisition(
+            lock=key, rel=self.fn.rel,
+            line=getattr(node, "lineno", 0),
+            held=self._held_snapshot(), via=via,
+        ))
+
+    # --------------------------------------------------------- statements
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs own their bodies
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            self._with(node)
+            return
+        if isinstance(node, ast.AsyncFor):
+            self._suspend(node, "async for")
+            self._expr(node.iter)
+            for stmt in list(node.body) + list(node.orelse):
+                self._stmt(stmt)
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            if len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                name = node.targets[0].id
+                self.local_names.add(name)
+                hit = self._expr_class(node.value)
+                if hit is not None:
+                    self.local_types[name] = hit
+                else:
+                    self.local_types.pop(name, None)
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    self._expr(t)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._expr(node.value)
+            if isinstance(node.target, ast.Name):
+                name = node.target.id
+                self.local_names.add(name)
+                hit = self.engine._ann_class(self.mod, node.annotation)
+                if hit is not None:
+                    self.local_types[name] = hit
+            return
+        # Generic statement: walk expressions in order, recurse into
+        # nested statement lists so held-set mutations stay sequential.
+        for field in ast.iter_child_nodes(node):
+            if isinstance(field, ast.stmt):
+                self._stmt(field)
+            elif isinstance(field, ast.expr):
+                self._expr(field)
+            elif isinstance(field, ast.ExceptHandler):
+                for stmt in field.body:
+                    self._stmt(stmt)
+            elif isinstance(field, (ast.arguments, ast.keyword)):
+                self._expr_children(field)
+
+    def _with(self, node: ast.stmt) -> None:
+        is_async = isinstance(node, ast.AsyncWith)
+        items = node.items  # type: ignore[attr-defined]
+        added: List[str] = []
+        for item in items:
+            expr = item.context_expr
+            key = self._lock_key(expr)
+            if is_async:
+                # Entering any async context manager can suspend; for an
+                # asyncio lock the suspension is the acquire itself.
+                detail = (
+                    f"async with {self.engine.short(key)}" if key
+                    else "async with"
+                )
+                self._suspend(item.context_expr, detail)
+            if key is not None:
+                self._acquire(
+                    key, expr, "async with" if is_async else "with"
+                )
+                if key not in self._held:
+                    self._held.add(key)
+                    added.append(key)
+            else:
+                self._expr(expr)
+        for stmt in node.body:  # type: ignore[attr-defined]
+            self._stmt(stmt)
+        for key in added:
+            self._held.discard(key)
+
+    # -------------------------------------------------------- expressions
+
+    def _expr_children(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _expr(self, node: ast.expr) -> None:
+        if isinstance(node, (ast.Lambda,)):
+            return  # runs later; registrations are caught at the call site
+        if isinstance(node, ast.Await):
+            self._await(node)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, awaited=False)
+            return
+        if isinstance(node, ast.Attribute):
+            prop = self._property_target(node)
+            if prop is not None:
+                self._record_call(prop, node)
+            self._expr(node.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.comprehension):
+                self._expr(child.iter)
+                for cond in child.ifs:
+                    self._expr(cond)
+
+    def _await(self, node: ast.Await) -> None:
+        value = node.value
+        if isinstance(value, ast.Call):
+            callee = self._call(value, awaited=True)
+            if callee is not None and callee.is_async:
+                self._suspend(
+                    node, f"await {callee.name}()", callee=callee.qname
+                )
+            else:
+                self._suspend(
+                    node, f"await {_dotted(value.func) or '<call>'}()"
+                )
+        else:
+            self._expr(value)
+            self._suspend(node, f"await {_dotted(value) or '<expr>'}")
+
+    def _call(
+        self, node: ast.Call, *, awaited: bool
+    ) -> Optional[FunctionInfo]:
+        dotted = _dotted(node.func)
+        if dotted and _last(dotted) in _SPAWN_WRAPPERS:
+            # Arguments run off this synchronous path (spawn-aware, like
+            # effects.py). Spawned callables are NOT treated as registered
+            # callbacks either: they run on their own thread/task, never
+            # synchronously inside a locked dynamic call site, so pairing
+            # them with held locks would only manufacture false cycles.
+            return None
+        self._note_registrations(node)
+        # .acquire()/.release() on a known lock mutate the held set for
+        # the REST of the function (or until released).
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "acquire", "release",
+        ):
+            key = self._lock_key(node.func.value)
+            if key is not None:
+                if node.func.attr == "acquire":
+                    if awaited:
+                        self._suspend(
+                            node, f"await {self.engine.short(key)}.acquire()"
+                        )
+                    self._acquire(key, node, "acquire()")
+                    self._held.add(key)
+                else:
+                    self._held.discard(key)
+                for arg in node.args:
+                    self._expr(arg)
+                return None
+        callee = self._resolve(node)
+        if callee is not None:
+            self._record_call(callee, node)
+        else:
+            hit = _classify_call(node, awaited=awaited)
+            if hit is not None and hit[0] == BLOCKING:
+                self.blocking.append(BlockingEvent(
+                    rel=self.fn.rel, line=node.lineno, detail=hit[1],
+                    held=self._held_snapshot(),
+                ))
+            elif self._held and self._is_dynamic_callable(node.func):
+                self.dynamic_calls.append(DynamicCall(
+                    rel=self.fn.rel, line=node.lineno,
+                    detail=f"{_dotted(node.func) or '<callable>'}(...)",
+                    held=self._held_snapshot(),
+                ))
+        for child in ast.iter_child_nodes(node):
+            if child is node.func:
+                if isinstance(child, ast.Attribute):
+                    self._expr(child.value)
+                continue
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.keyword):
+                self._expr(child.value)
+        return callee
+
+    def _record_call(self, callee: FunctionInfo, node: ast.AST) -> None:
+        key = (getattr(node, "lineno", 0), callee.qname)
+        if key in self._seen_calls:
+            return
+        self._seen_calls.add(key)
+        self.calls.append(CallEvent(
+            callee=callee.qname, rel=self.fn.rel,
+            line=getattr(node, "lineno", 0), held=self._held_snapshot(),
+        ))
+
+    def _is_dynamic_callable(self, func: ast.expr) -> bool:
+        """A callable the graph cannot see through: a parameter/local
+        variable, or a self-attribute that is not a method (a stored
+        callback field). Module aliases (`log.warning`) are excluded —
+        they are ordinary library calls, not injected callables."""
+        if isinstance(func, ast.Name):
+            return func.id in self.local_names
+        attr = _self_attr(func)
+        if attr is not None and self.class_key is not None:
+            cls = self.engine.project.classes[self.class_key]
+            owner = self.engine.project.modules[cls.rel]
+            method = self.engine.project._lookup_method(
+                owner, cls.name, attr
+            )
+            facts = self.engine._class_facts.get(self.class_key)
+            typed = facts is not None and attr in facts.attr_types
+            return method is None and not typed
+        return False
+
+    def _note_registrations(self, node: ast.Call) -> None:
+        """Collect callables handed to registrar-style calls:
+        `set_state_change_callback(lambda: ...)`, `callback=self._on_x`,
+        `on_change=handler`."""
+        func_name = ""
+        if isinstance(node.func, ast.Attribute):
+            func_name = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            func_name = node.func.id
+        is_registrar = bool(_REGISTRAR_RE.match(func_name))
+        for arg in node.args:
+            if is_registrar:
+                self._register_callable(arg, node.lineno)
+        for kw in node.keywords:
+            if is_registrar or (
+                kw.arg is not None and _CALLBACK_KWARG_RE.search(kw.arg)
+            ):
+                self._register_callable(kw.value, node.lineno)
+
+    def _register_callable(self, expr: ast.expr, lineno: int) -> None:
+        if isinstance(expr, ast.Lambda):
+            body = expr.body
+            if isinstance(body, ast.Call):
+                callee = self._resolve(body)
+                if callee is not None:
+                    self.registered.setdefault(
+                        callee.qname, (self.fn.rel, lineno)
+                    )
+            return
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            target = self.engine.project.resolve_call(
+                self.mod, expr, self.fn.class_name, self.fn
+            )
+            if target is None and isinstance(expr, ast.Attribute):
+                base = self._expr_class(expr.value)
+                if base is not None:
+                    cls = self.engine.project.classes[base]
+                    owner = self.engine.project.modules[cls.rel]
+                    target = self.engine.project._lookup_method(
+                        owner, cls.name, expr.attr
+                    )
+            if target is not None:
+                self.registered.setdefault(
+                    target.qname, (self.fn.rel, lineno)
+                )
+
+
+# One engine per Project instance, shared by all four concurrency rules
+# (same lifecycle discipline as effects.effect_engine).
+_ENGINES: MutableMapping[Project, ConcurrencyEngine] = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def concurrency_engine(project: Project) -> ConcurrencyEngine:
+    engine = _ENGINES.get(project)
+    if engine is None:
+        engine = ConcurrencyEngine(project)
+        _ENGINES[project] = engine
+    return engine

@@ -9,11 +9,17 @@ abstract-interpretation rules (pspec-flow, donation-safety, dtype-flow,
 program-inventory) additionally propagate values — sharding meaning,
 dtype, donation status, compiled-program domains — via analysis/absint.py;
 the effect/taint rules (state-machine-determinism, wire-taint) run on the
-interprocedural effect lattice in analysis/effects.py.
+interprocedural effect lattice in analysis/effects.py; the concurrency
+rules (atomicity-across-await, lock-order, await-under-lock, and the
+per-file cancellation-safety) run on the suspension-point + lockset
+model in analysis/concurrency.py.
 """
 
 from . import (  # noqa: F401
     async_blocking,
+    atomicity_across_await,
+    await_under_lock,
+    cancellation_safety,
     canonical_pspec,
     config_consistency,
     deadline_flow,
@@ -23,6 +29,7 @@ from . import (  # noqa: F401
     guarded_by,
     guarded_by_flow,
     host_sync,
+    lock_order,
     metrics_registry,
     orphan_task,
     program_inventory,

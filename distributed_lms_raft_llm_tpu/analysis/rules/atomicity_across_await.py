@@ -1,0 +1,331 @@
+"""atomicity-across-await: loop-confined state must not be decided
+before an await and written after it without re-validation.
+
+Incident class: the event-loop TOCTOU. Single-threaded asyncio code
+needs no locks *between* suspension points — every ``await`` is the only
+place another task can run. Which means every read-decide-await-write
+sequence silently assumes nothing changed across the await:
+
+    if rid not in self._inflight:          # read + decide
+        result = await self._fetch(rid)    # suspension — anyone may run
+        self._inflight[rid] = result       # write the stale decision
+
+Two tasks hit the same branch, both await, both write: double fetch,
+lost update, duplicate side effects. The batcher/pool admission paths
+are exactly this shape.
+
+The rule runs per async method over :mod:`analysis.concurrency`'s *true*
+suspension model (an await of a project-local coroutine that never
+suspends is not a window; ``async for``/``async with`` are), and flags a
+write of a shared attribute when:
+
+- some read of the same attribute happens before the latest suspension
+  preceding the write, and
+- no read of it happens between that suspension and the write
+  (a re-read after the await is the re-validation the fix needs).
+
+Reads that are just the base of a store target (``self._cache[k] = v``
+reads ``self._cache`` only to store into it) do not count — a blind
+store after an await is not a decision. An ``AugAssign`` counts as an
+implicit read at the statement start (``self._n += await f()`` is a
+lost-update by construction).
+
+Which attributes are "shared": every ``# guarded-by: event-loop``
+annotated attribute (the PR-6 convention — loop-confined by contract),
+plus a conservative inference fallback for unannotated state: an
+attribute initialized in ``__init__`` and mutated in two or more other
+methods, at least one of them async, with no other guarded-by
+annotation (lock-guarded attrs have their own rule) and that is not
+itself a lock.
+
+Remedies: re-read/re-check after the await; restructure so decide and
+write sit in one synchronous stretch (decide after the await); or hold
+an ``asyncio.Lock`` across the whole sequence. Sanction deliberate
+last-wins semantics with ``# lint: disable=atomicity-across-await`` and
+a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..concurrency import concurrency_engine
+from ..core import Finding, Source, register
+from ..project import ClassInfo, Project, ProjectRule
+from .guarded_by import EVENT_LOOP, _line_annotation, _self_attr
+
+_Pos = Tuple[int, int]
+
+# In-place mutator method names (mirrors guarded_by's set): a
+# `self._q.append(x)` is a write of `self._q` for interleaving purposes.
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "add", "discard", "setdefault", "sort", "reverse",
+    "appendleft", "popleft", "put_nowait",
+}
+
+
+def _own_nodes(fn: ast.AST) -> List[ast.AST]:
+    """All nodes of `fn`'s body excluding nested function/lambda bodies.
+
+    Nested defs are opaque wherever they appear — as child nodes or as
+    statements sitting directly in the body list.
+    """
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _is_store_base(node: ast.expr) -> bool:
+    """Is this Load just the base of a store target (`self.x[k] = v`)?"""
+    cur: ast.AST = node
+    parent = getattr(cur, "parent", None)
+    while isinstance(parent, (ast.Subscript, ast.Attribute)) \
+            and getattr(parent, "value", None) is cur:
+        if isinstance(parent.ctx, (ast.Store, ast.Del)):
+            return True
+        cur = parent
+        parent = getattr(cur, "parent", None)
+    return False
+
+
+def _end_pos(node: ast.AST) -> _Pos:
+    return (
+        getattr(node, "end_lineno", getattr(node, "lineno", 0)) or 0,
+        getattr(node, "end_col_offset", 0) or 0,
+    )
+
+
+def _start_pos(node: ast.AST) -> _Pos:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+@register
+class AtomicityAcrossAwaitRule(ProjectRule):
+    name = "atomicity-across-await"
+    description = (
+        "shared event-loop state read before a suspension point and "
+        "written after it without re-validation — the event-loop TOCTOU"
+    )
+
+    def check_project(self, project: Project) -> List[Finding]:
+        engine = concurrency_engine(project)
+        # _own_nodes is needed once per method in _shared_attrs and again
+        # in _check_method; memoize per function node for the run.
+        self._own_cache: Dict[int, List[ast.AST]] = {}
+        findings: List[Finding] = []
+        for class_key in sorted(project.classes):
+            cls = project.classes[class_key]
+            src = project.sources.get(cls.rel)
+            if src is None:
+                continue
+            shared = self._shared_attrs(src, cls)
+            if not shared:
+                continue
+            facts = engine._class_facts.get(class_key)
+            lock_attrs = (
+                set(facts.lock_attrs) if facts is not None else set()
+            )
+            for name, method in sorted(cls.methods.items()):
+                if not method.is_async:
+                    continue
+                susp = [
+                    ((s.line, s.col), s) for s in
+                    engine.true_suspensions(method.qname)
+                ]
+                if not susp:
+                    continue
+                findings.extend(self._check_method(
+                    src, cls, method.node, shared, lock_attrs, susp
+                ))
+        return findings
+
+    def _own(self, fn: ast.AST) -> List[ast.AST]:
+        cached = self._own_cache.get(id(fn))
+        if cached is None:
+            cached = _own_nodes(fn)
+            self._own_cache[id(fn)] = cached
+        return cached
+
+    # ----------------------------------------------------- shared attrs
+
+    def _shared_attrs(
+        self, src: Source, cls: ClassInfo
+    ) -> Dict[str, str]:
+        """attr -> basis ("annotated" | "inferred")."""
+        annotated: Set[str] = set()
+        other_guard: Set[str] = set()
+        init_attrs: Set[str] = set()
+        writers: Dict[str, Set[str]] = {}
+        async_writers: Dict[str, Set[str]] = {}
+        for method in cls.methods.values():
+            is_init = method.name == "__init__"
+            is_async = method.is_async
+            for node in self._own(method.node):
+                for attr in self._written_attrs(node):
+                    if is_init:
+                        init_attrs.add(attr)
+                        guard = _line_annotation(src, node.lineno)
+                        if guard == EVENT_LOOP:
+                            annotated.add(attr)
+                        elif guard is not None:
+                            other_guard.add(attr)
+                    else:
+                        writers.setdefault(attr, set()).add(method.name)
+                        if is_async:
+                            async_writers.setdefault(attr, set()).add(
+                                method.name
+                            )
+        # Annotations may also sit on non-__init__ declarations.
+        for node in ast.walk(cls.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                guard = _line_annotation(src, node.lineno)
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if guard == EVENT_LOOP:
+                        annotated.add(attr)
+                    elif guard is not None:
+                        other_guard.add(attr)
+        out: Dict[str, str] = {}
+        for attr in annotated:
+            out[attr] = "annotated"
+        for attr, methods in writers.items():
+            if attr in out or attr in other_guard:
+                continue
+            if attr not in init_attrs:
+                continue
+            if len(methods) >= 2 and async_writers.get(attr):
+                out[attr] = "inferred"
+        return out
+
+    @staticmethod
+    def _written_attrs(node: ast.AST) -> List[str]:
+        out: List[str] = []
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out.append(attr)
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        out.append(attr)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+                attr = _self_attr(func.value)
+                if attr is not None:
+                    out.append(attr)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        out.append(attr)
+        return out
+
+    # --------------------------------------------------------- the check
+
+    def _check_method(
+        self,
+        src: Source,
+        cls: ClassInfo,
+        fn: ast.AST,
+        shared: Dict[str, str],
+        lock_attrs: Set[str],
+        susp: List[Tuple[_Pos, object]],
+    ) -> List[Finding]:
+        reads: List[Tuple[str, _Pos]] = []
+        writes: List[Tuple[str, _Pos, int, str]] = []  # attr, end, line, kind
+        for node in self._own(fn):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                attr = _self_attr(node)
+                if attr in shared and attr not in lock_attrs \
+                        and not _is_store_base(node) \
+                        and not self._is_mutator_base(node):
+                    reads.append((str(attr), _start_pos(node)))
+            for attr in self._written_attrs(node):
+                if attr not in shared or attr in lock_attrs:
+                    continue
+                kind = (
+                    "augmented assignment"
+                    if isinstance(node, ast.AugAssign) else
+                    "mutation" if isinstance(node, ast.Call) else
+                    "assignment"
+                )
+                writes.append(
+                    (attr, _end_pos(node), node.lineno, kind)
+                )
+                if isinstance(node, ast.AugAssign):
+                    # The old value is read at the statement start.
+                    reads.append((attr, _start_pos(node)))
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+        positions = sorted(p for p, _ in susp)
+        details = {p: s for p, s in susp}
+        for attr, wpos, wline, kind in writes:
+            before = [p for p in positions if p < wpos]
+            if not before:
+                continue
+            s = max(before)
+            pre = [p for a, p in reads if a == attr and p <= s]
+            if not pre:
+                continue
+            if any(s < p < wpos for a, p in reads if a == attr):
+                continue  # re-validated after the await
+            key = (wline, attr)
+            if key in seen:
+                continue
+            seen.add(key)
+            susp_obj = details[s]
+            basis = shared[attr]
+            basis_note = (
+                "declared `# guarded-by: event-loop`" if basis == "annotated"
+                else "inferred shared (initialized in __init__, mutated "
+                     "from multiple methods)"
+            )
+            findings.append(self.finding(
+                src, wline,
+                f"{cls.name}: self.{attr} is read (line {max(pre)[0]}) "
+                f"before a suspension point (line "
+                f"{getattr(susp_obj, 'line', s[0])}, "
+                f"{getattr(susp_obj, 'detail', 'await')}) and this "
+                f"{kind} happens after it without re-reading — other "
+                "tasks run across the await, so the decision may be "
+                f"stale ({basis_note}); re-validate self.{attr} after "
+                "the await, restructure decide+write into one "
+                "synchronous stretch, or hold an asyncio.Lock across "
+                "the sequence",
+            ))
+        return findings
+
+    @staticmethod
+    def _is_mutator_base(node: ast.expr) -> bool:
+        """`self._q` inside `self._q.append(x)` — counted as the write,
+        not as a decision read."""
+        parent = getattr(node, "parent", None)
+        if isinstance(parent, ast.Attribute) and parent.value is node \
+                and parent.attr in _MUTATORS:
+            grand = getattr(parent, "parent", None)
+            return isinstance(grand, ast.Call) and grand.func is parent
+        return False

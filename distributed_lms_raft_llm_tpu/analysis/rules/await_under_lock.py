@@ -1,0 +1,111 @@
+"""await-under-lock: never suspend or block while holding a threading lock.
+
+Incident class: the event loop freezes without a single "slow" function
+existing. A ``threading.Lock`` held across an ``await`` stays held while
+the loop runs *other* tasks; any of them touching the same lock blocks
+its thread — and when that thread IS the loop thread, the whole serving
+plane stops. The same applies to a blocking intrinsic (``time.sleep``,
+``subprocess.*`` — PR 18's ``BLOCKING`` effect) reached while a
+threading lock is held on the loop: the lock converts one slow call into
+a convoy every other task joins.
+
+The rule walks every *async* function with :mod:`analysis.concurrency`'s
+held-set model (lexical ``with`` stacks, ``acquire()``/``release()``
+tracking, and ``# guarded-by: <lock>`` entry-held annotations) and flags:
+
+- a **true suspension point** (an ``await`` that can actually yield —
+  awaiting a project-local coroutine that never suspends is exempt by
+  the fixpoint model; ``async for`` / ``async with``) while any
+  *threading*-kind lock is held;
+- a **blocking intrinsic** at a call site where a threading lock is
+  held;
+- a **call into a path with the BLOCKING effect** (PR 18's lattice,
+  witness chain attached) while a threading lock is held.
+
+``asyncio.Lock`` is exempt on purpose: suspending under one is its
+design (other tasks waiting on that lock queue, the loop keeps running).
+
+Remedies: shrink the critical section to the synchronous part (snapshot
+under the lock, await after release); replace the lock with
+``asyncio.Lock`` when every holder is on the loop; move the blocking
+call to ``run_in_executor``. Sanction deliberate exceptions with
+``# lint: disable=await-under-lock`` and a reason.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set, Tuple
+
+from ..concurrency import concurrency_engine
+from ..core import Finding, register
+from ..effects import BLOCKING, effect_engine
+from ..project import Project, ProjectRule
+
+
+@register
+class AwaitUnderLockRule(ProjectRule):
+    name = "await-under-lock"
+    description = (
+        "suspension point or blocking call reachable while a threading "
+        "lock is held in an async function — blocks every task on the "
+        "event loop behind the lock"
+    )
+
+    def check_project(self, project: Project) -> List[Finding]:
+        engine = concurrency_engine(project)
+        effects = effect_engine(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def emit(rel: str, line: int, kind: str, message: str) -> None:
+            key = (rel, line, kind)
+            if key in seen:
+                return
+            seen.add(key)
+            src = project.sources.get(rel)
+            if src is not None:
+                findings.append(self.finding(src, line, message))
+
+        for qname, fn in sorted(project.functions.items()):
+            if not fn.is_async:
+                continue
+            short_fn = qname.split("::", 1)[-1]
+            for susp in engine.true_suspensions(qname):
+                held = engine.held_threading(susp.held)
+                if not held:
+                    continue
+                names = ", ".join(engine.short(k) for k in held)
+                emit(susp.rel, susp.line, "suspend",
+                     f"{short_fn} suspends ({susp.detail}) while holding "
+                     f"threading lock(s) {names} — the lock stays held "
+                     "across the yield and any other task touching it "
+                     "blocks the loop thread; shrink the critical "
+                     "section, or use asyncio.Lock if all holders run "
+                     "on the loop")
+            for block in engine.blocking_events(qname):
+                held = engine.held_threading(block.held)
+                if not held:
+                    continue
+                names = ", ".join(engine.short(k) for k in held)
+                emit(block.rel, block.line, "block",
+                     f"{short_fn} calls blocking {block.detail} while "
+                     f"holding threading lock(s) {names} on the event "
+                     "loop — every other task contending the lock "
+                     "convoys behind it; move the call off the loop "
+                     "(run_in_executor) or out of the critical section")
+            for call in engine.calls(qname):
+                held = engine.held_threading(call.held)
+                if not held:
+                    continue
+                if BLOCKING not in effects.effects(call.callee):
+                    continue
+                witness = effects.witness(call.callee, BLOCKING)
+                chain = (witness.pretty() if witness
+                         else call.callee.split("::", 1)[-1])
+                names = ", ".join(engine.short(k) for k in held)
+                emit(call.rel, call.line, "call-block",
+                     f"{short_fn} holds threading lock(s) {names} and "
+                     f"calls into a blocking path: {chain} — the lock "
+                     "pins the loop thread behind the block; hoist the "
+                     "call out of the critical section")
+        return findings

@@ -1,0 +1,273 @@
+"""cancellation-safety: async cleanup must survive task cancellation.
+
+Incident class: cancellation is asyncio's only structured teardown
+signal — eviction drains, hedged-send losers, shutdown paths all rely on
+``CancelledError`` propagating promptly and cleanup still running. Three
+lexical shapes quietly break that contract:
+
+- **await in finally** — when the task is being cancelled, the
+  ``finally`` block runs with the cancellation pending; a plain
+  ``await`` there can be interrupted by a second ``CancelledError`` and
+  the rest of the cleanup never executes (half-closed sockets, leaked
+  slots). Allowed forms: ``await asyncio.shield(...)`` (explicitly
+  protected), ``await asyncio.wait_for(...)`` (bounded, interruption
+  acknowledged), and the reap idiom — ``t.cancel()`` earlier in the same
+  ``finally`` followed by ``await asyncio.gather/wait(...)`` (collecting
+  tasks you just cancelled is exactly how cleanup should look).
+- **swallowing CancelledError** — a bare ``except:``, ``except
+  BaseException:``, or ``except (asyncio.)CancelledError:`` whose body
+  never re-raises eats the cancellation; the caller's ``await
+  task`` then hangs or the task zombies on. (``except Exception`` is
+  fine: ``CancelledError`` derives from ``BaseException`` since 3.8.)
+  Exempt: the canceller-absorb idiom — *this* function called
+  ``.cancel()`` earlier and the try body awaits the task; absorbing the
+  CancelledError you yourself injected is the textbook reap
+  (``t.cancel(); try: await t; except CancelledError: pass``).
+- **cancel without await** — ``t.cancel()`` only *requests*
+  cancellation; until someone awaits the task (or gathers it), the
+  ``CancelledError`` has not been delivered, cleanup has not run, and
+  exceptions vanish. A function that cancels and never awaits anything
+  that could reap the task leaks it. Flagged only for receivers
+  provably tasks — assigned from ``create_task``/``ensure_future`` in
+  the same function; ``.cancel()`` on values of unknown type (params,
+  attributes, non-task objects with their own sync ``cancel()``) is
+  skipped rather than guessed at.
+
+The checks run lexically inside ``async def`` bodies only (nested sync
+defs excluded): sync code cannot await the tasks it cancels, and
+cancellation semantics are an event-loop contract. The await-in-finally
+check additionally skips ``tests/`` — test coroutines run to completion
+under ``asyncio.run`` with no canceller, so their ``finally`` blocks
+never race a pending CancelledError.
+
+Sanction deliberate exceptions (a span that must close before re-raise,
+fire-and-forget cancels at interpreter shutdown) in place with
+``# lint: disable=cancellation-safety`` and a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Finding, Rule, Source, register
+from ..project import _dotted
+
+
+def _own_nodes(body: List[ast.stmt]) -> List[ast.AST]:
+    """All nodes under `body`, excluding nested function/lambda bodies.
+
+    A nested def is opaque wherever it appears — as a child node or as a
+    statement sitting directly in `body` (e.g. a local helper coroutine
+    defined inside a ``finally``).
+    """
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _tail(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+_SHIELDED = {"shield", "wait_for"}
+_REAPERS = {"gather", "wait"}
+_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _handler_names(type_expr: Optional[ast.expr]) -> List[str]:
+    if type_expr is None:
+        return [""]  # bare except
+    if isinstance(type_expr, ast.Tuple):
+        return [_dotted(e) for e in type_expr.elts]
+    return [_dotted(type_expr)]
+
+
+def _swallows_cancellation(names: List[str]) -> bool:
+    for name in names:
+        if name == "":
+            return True
+        if name == "BaseException" or _tail(name) == "CancelledError":
+            return True
+    return False
+
+
+@register
+class CancellationSafetyRule(Rule):
+    name = "cancellation-safety"
+    description = (
+        "async cleanup hazards: await in finally without shield/timeout, "
+        "CancelledError swallowed without re-raise, .cancel() on a task "
+        "that is never awaited"
+    )
+
+    def check(self, src: Source) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                findings.extend(self._check_async_fn(src, node))
+        return findings
+
+    def _check_async_fn(
+        self, src: Source, fn: ast.AsyncFunctionDef
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        own = _own_nodes(fn.body)
+        in_tests = src.rel.startswith("tests/") or "/tests/" in src.rel
+        cancel_lines = [
+            node.lineno for node in own
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "cancel"
+        ]
+        for node in own:
+            if isinstance(node, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+                if not in_tests:
+                    findings.extend(self._check_finally(src, node))
+                findings.extend(
+                    self._check_handlers(src, node, cancel_lines)
+                )
+        findings.extend(self._check_unawaited_cancels(src, fn, own))
+        return findings
+
+    # --------------------------------------------------- await in finally
+
+    def _check_finally(self, src: Source, node: ast.Try) -> List[Finding]:
+        findings: List[Finding] = []
+        cancelled_something = False
+        for stmt in node.finalbody:
+            for sub in _own_nodes([stmt]):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "cancel":
+                    cancelled_something = True
+                if not isinstance(sub, ast.Await):
+                    continue
+                value = sub.value
+                tail = ""
+                if isinstance(value, ast.Call):
+                    tail = _tail(_dotted(value.func))
+                if tail in _SHIELDED:
+                    continue
+                if tail in _REAPERS and cancelled_something:
+                    continue  # the cancel-then-reap cleanup idiom
+                findings.append(self.finding(
+                    src, sub,
+                    "await in `finally` of an async function without "
+                    "asyncio.shield/wait_for — if this task is being "
+                    "cancelled, the await can be interrupted and the "
+                    "rest of the cleanup never runs; wrap it in "
+                    "asyncio.shield(...) (must-complete cleanup) or "
+                    "asyncio.wait_for(..., timeout) (bounded best "
+                    "effort)",
+                ))
+        return findings
+
+    # --------------------------------------------- swallowed cancellation
+
+    def _check_handlers(
+        self, src: Source, node: ast.Try, cancel_lines: List[int]
+    ) -> List[Finding]:
+        try_awaits = any(
+            isinstance(sub, ast.Await) for sub in _own_nodes(node.body)
+        )
+        findings: List[Finding] = []
+        for handler in node.handlers:
+            names = _handler_names(handler.type)
+            if not _swallows_cancellation(names):
+                continue
+            reraises = any(
+                isinstance(sub, ast.Raise)
+                for sub in _own_nodes(handler.body)
+            )
+            if reraises:
+                continue
+            if try_awaits and any(
+                line < handler.lineno for line in cancel_lines
+            ):
+                # Canceller-absorb: this function cancelled the task and
+                # the try body awaits it — swallowing the CancelledError
+                # it injected is the reap, not a lost cancellation.
+                continue
+            what = (
+                "bare `except:`" if names == [""] else
+                f"`except {', '.join(n for n in names if n)}:`"
+            )
+            findings.append(self.finding(
+                src, handler,
+                f"{what} swallows CancelledError without re-raising — "
+                "the task keeps running after cancellation and the "
+                "canceller's `await task` may hang; catch Exception "
+                "instead (CancelledError derives from BaseException), "
+                "or re-raise after cleanup",
+            ))
+        return findings
+
+    # ----------------------------------------------- cancel without await
+
+    def _check_unawaited_cancels(
+        self, src: Source, fn: ast.AsyncFunctionDef, own: List[ast.AST]
+    ) -> List[Finding]:
+        cancels: List[ast.Call] = []
+        awaited: Set[str] = set()
+        spawned: Set[str] = set()
+        has_reaper = False
+        for node in own:
+            if isinstance(node, ast.Await):
+                value = node.value
+                if isinstance(value, ast.Call):
+                    if _tail(_dotted(value.func)) in _REAPERS:
+                        has_reaper = True
+                for sub in ast.walk(value):
+                    if isinstance(sub, (ast.Name, ast.Attribute)):
+                        dotted = _dotted(sub)
+                        if dotted:
+                            awaited.add(dotted)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                func = node.value.func
+                spawner = (
+                    func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else ""
+                )
+                if spawner in _SPAWNERS:
+                    for target in node.targets:
+                        dotted = _dotted(target)
+                        if dotted:
+                            spawned.add(dotted)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "cancel":
+                receiver = _dotted(node.func.value)
+                if receiver:
+                    cancels.append(node)
+        if has_reaper:
+            # One gather/wait reaps every task this function cancelled.
+            return []
+        findings: List[Finding] = []
+        for call in cancels:
+            receiver = _dotted(call.func.value)  # type: ignore[attr-defined]
+            if receiver not in spawned:
+                # Unknown type — could be a non-task with a sync
+                # cancel(); only provably-spawned tasks are flagged.
+                continue
+            if receiver in awaited:
+                continue
+            findings.append(self.finding(
+                src, call,
+                f"{receiver}.cancel() but {receiver} is never awaited in "
+                "this function — cancel() only requests cancellation; "
+                "until the task is awaited (or gathered with "
+                "return_exceptions=True) its cleanup has not run and "
+                "its exceptions vanish; await it, or hand it to a "
+                "reaper that does",
+            ))
+        return findings

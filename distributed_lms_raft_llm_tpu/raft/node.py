@@ -126,8 +126,12 @@ class RaftNode:
 
     async def stop(self) -> None:
         self._stopped = True
-        # Snapshot: completing tasks remove themselves from the live list.
+        # Snapshot AND clear before awaiting: completing tasks remove
+        # themselves from the live list (tolerating the clear — see
+        # _discard_task), and clearing after the awaits would race any
+        # delivery task the outbox pump registered mid-await.
         pending = list(self._tasks)
+        self._tasks.clear()
         for t in pending:
             t.cancel()
         for t in pending:
@@ -135,7 +139,6 @@ class RaftNode:
                 await t
             except asyncio.CancelledError:
                 pass
-        self._tasks.clear()
         self._fail_waiters(RuntimeError("raft node stopped"))
         await self.transport.close()
 

@@ -44,6 +44,7 @@ from ..raft import RaftConfig
 from ..raft.grpc_transport import RaftServicer
 from ..utils.diskfaults import DiskFaultInjector
 from ..utils.faults import CampaignRunner, FaultInjector
+from ..utils import locks
 from ..utils.guards import make_serving_watchdog
 from ..utils.metrics import Metrics
 from ..utils.timeline import (
@@ -349,6 +350,9 @@ async def serve_async(args) -> None:
     faults = FaultInjector(seed=args.fault_seed)
     disk_faults = DiskFaultInjector(seed=args.fault_seed)
     metrics = Metrics()
+    # Lock-order violations detected by OrderedLock (when debug
+    # recording is on — the sim enables it) surface as a counter here.
+    locks.set_metrics_sink(metrics)
     lms_node = LMSNode(
         args.id, addresses, args.data_dir, raft_config=raft_config,
         snapshot_every=args.snapshot_every, fault_injector=faults,
@@ -591,19 +595,31 @@ async def serve_async(args) -> None:
     finally:
         reporter.cancel()
         watchdog.cancel()
-        campaigns.cancel()
-        await pool.close()
-        if sampler is not None:
-            sampler.stop()
-        if health is not None:
-            await health.stop()
-        if router is not None:
-            await router.close()
-        for gid in range(1, args.groups):
-            await lms_nodes[gid].stop()
-        for group_server in group_servers:
-            await group_server.stop(0.5)
-        await lms_node.stop()
+        campaigns.cancel()  # sync bookkeeping on CampaignRunner, not a task
+        # Reap the cancelled loops: confirms the CancelledError was
+        # delivered (their cleanup ran) before tearing down what they
+        # poke at, and surfaces any exception they died with.
+        await asyncio.gather(reporter, watchdog, return_exceptions=True)
+
+        async def _shutdown() -> None:
+            await pool.close()
+            if sampler is not None:
+                sampler.stop()
+            if health is not None:
+                await health.stop()
+            if router is not None:
+                await router.close()
+            for gid in range(1, args.groups):
+                await lms_nodes[gid].stop()
+            for group_server in group_servers:
+                await group_server.stop(0.5)
+            await lms_node.stop()
+
+        # One bounded await for the whole teardown sequence: if serve()
+        # itself is being cancelled (asyncio.run cancels the main task on
+        # KeyboardInterrupt), a second CancelledError would otherwise
+        # abort the cleanup at whichever raw await it happened to be in.
+        await asyncio.wait_for(_shutdown(), timeout=30.0)
 
 
 def main(argv=None) -> None:

@@ -35,6 +35,7 @@ import grpc
 from ..client import LMSClient
 from ..client.client import NoLeader
 from ..config import SimConfig
+from ..utils import locks
 from ..utils import metrics_registry as metric
 from ..utils import pdf
 from ..utils.metrics import Metrics
@@ -172,6 +173,15 @@ class SemesterSim:
         # process), which would pollute the per-stage p95s and could pin
         # a stale trace as this run's slowest exemplar.
         get_tracer().reset()
+        # Live lock-order auditing across the whole run: every
+        # OrderedLock acquisition in the in-process cluster lands in the
+        # global acquisition graph; violations surface both through the
+        # lock_order_violations counter and locks.violations(), and the
+        # recorded graph stays readable after the run for the
+        # static/dynamic cross-validation test.
+        locks.reset()
+        locks.set_metrics_sink(self.metrics)
+        locks.enable_recording()
         try:
             # Inside the try: a partial boot (no leader within the
             # timeout, a stolen port) must still tear the cluster down,
@@ -237,6 +247,8 @@ class SemesterSim:
             if self._ops_bot is not None:
                 self._ops_bot.close()
             self.cluster.stop()
+            locks.disable_recording()
+            locks.set_metrics_sink(None)
 
     # ---------------------------------------------------------------- setup
 

@@ -160,6 +160,8 @@ class HealthServer:
         finally:
             writer.close()
             try:
-                await writer.wait_closed()
-            except ConnectionError:
+                # Bounded: a pending cancellation must not be able to
+                # interrupt the drain and skip the rest of the teardown.
+                await asyncio.wait_for(writer.wait_closed(), 1.0)
+            except (asyncio.TimeoutError, ConnectionError):
                 pass

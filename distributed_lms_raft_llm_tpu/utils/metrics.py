@@ -13,10 +13,11 @@ from __future__ import annotations
 
 import bisect
 import math
-import threading
 import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from .locks import make_lock
 
 
 def percentile_of_sorted(samples: Sequence[float], p: float) -> float:
@@ -58,7 +59,7 @@ class LatencyHistogram:
         self._recent: Deque[Tuple[float, float]] = deque(  # guarded-by: _lock
             maxlen=recent
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("LatencyHistogram._lock")
 
     def observe(self, seconds: float) -> None:
         with self._lock:
@@ -118,7 +119,8 @@ class Metrics:
         self._counters: Dict[str, int] = {}          # guarded-by: _lock
         self._hists: Dict[str, LatencyHistogram] = {}  # guarded-by: _lock
         self._gauges: Dict[str, float] = {}          # guarded-by: _lock
-        self._lock = threading.Lock()
+        # Named for the live acquisition-order graph (utils/locks.py).
+        self._lock = make_lock("Metrics._lock")
 
     def set_gauge(self, name: str, value: float) -> None:
         """Last-value gauge for dimensionless readings (ratios, sizes) —

@@ -634,6 +634,17 @@ SERVING_TICK_STALLS = counter(
     "logged)",
 )
 
+# Lock-order auditing (utils/locks.py OrderedLock, debug recording mode):
+# the runtime counterpart of the lock-order lint rule.
+
+LOCK_ORDER_VIOLATIONS = counter(
+    "lock_order_violations",
+    "lock acquisitions that re-entered a held non-reentrant lock or "
+    "closed a cycle in the live acquisition-order graph (recorded by "
+    "utils/locks.py OrderedLock when debug recording is on; each also "
+    "lands in locks.violations() with the offending edge)",
+)
+
 
 if __name__ == "__main__":  # pragma: no cover - convenience
     print(render_markdown_table())

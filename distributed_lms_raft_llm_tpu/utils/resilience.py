@@ -26,9 +26,10 @@ without sleeping.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .locks import make_lock
 
 # Metadata key carrying the remaining budget in milliseconds. A *relative*
 # budget (not an absolute timestamp) survives clock skew between hosts; each
@@ -229,7 +230,9 @@ class CircuitBreaker:
         self.half_open_max = max(1, half_open_max)
         self._clock = clock
         self._on_state_change = on_state_change
-        self._lock = threading.Lock()
+        # Named for the live acquisition-order graph (utils/locks.py);
+        # the name matches the static analysis's short lock key.
+        self._lock = make_lock("CircuitBreaker._lock")
         self._state = self.CLOSED        # guarded-by: _lock
         self._consecutive_failures = 0   # guarded-by: _lock
         self._opened_at = 0.0            # guarded-by: _lock

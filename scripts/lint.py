@@ -5,6 +5,7 @@
     python scripts/lint.py --json          # machine-readable findings
     python scripts/lint.py --sarif         # SARIF 2.1.0 (CI/editor annotations)
     python scripts/lint.py --rules guarded-by,deadline-flow engine/
+    python scripts/lint.py --rules lock-order,atomicity-across-await
     python scripts/lint.py --changed       # only git-changed files (pre-commit)
     python scripts/lint.py --baseline lint-baseline.json   # fail on NEW only
     python scripts/lint.py --types         # + the mypy strict-subset gate
@@ -67,6 +68,7 @@ TYPED_SUBSET = [
     "distributed_lms_raft_llm_tpu/utils/resilience.py",
     "distributed_lms_raft_llm_tpu/utils/guards.py",
     "distributed_lms_raft_llm_tpu/utils/metrics_registry.py",
+    "distributed_lms_raft_llm_tpu/utils/locks.py",
     "distributed_lms_raft_llm_tpu/analysis",
 ]
 

@@ -56,3 +56,18 @@ def strict_dispatch_guard():
         yield
 
 
+@pytest.fixture
+def ordered_locks():
+    """Lock-order assertion mode: every OrderedLock acquisition during
+    the test feeds the live acquisition graph (utils/locks.py), and the
+    fixture asserts it acyclic — with no re-entry and no cycle-closing
+    edge — on teardown. The runtime counterpart of the `lock-order`
+    lint rule; the semester sim enables the same recording itself."""
+    from distributed_lms_raft_llm_tpu.utils import locks
+
+    locks.reset()
+    with locks.recording():
+        yield locks
+    locks.assert_acyclic()
+
+

@@ -1,0 +1,85 @@
+"""atomicity-across-await fixtures: the event-loop TOCTOU in miniature.
+
+Annotated (`# guarded-by: event-loop`) and inferred shared attributes,
+true-suspension modelling (awaiting a coroutine that never suspends is
+not a window), re-validation, blind stores, and a sanctioned last-wins
+write.
+"""
+
+import asyncio
+
+
+class Cache:
+    def __init__(self):
+        self._inflight = {}  # guarded-by: event-loop
+        self._hits = 0       # guarded-by: event-loop
+
+    async def _fetch(self, rid):
+        await asyncio.sleep(0.001)
+        return rid
+
+    async def _count(self):
+        await asyncio.sleep(0.001)
+        return 1
+
+    async def _tally(self):
+        # Async but never suspends: awaiting it is NOT a window.
+        return len(self._inflight)
+
+    async def bad_admit(self, rid):
+        # Decide on a pre-await read, write the stale decision after.
+        if rid not in self._inflight:
+            data = await self._fetch(rid)
+            self._inflight[rid] = data  # EXPECT: atomicity-across-await
+        return self._inflight[rid]
+
+    async def bad_lost_update(self):
+        self._hits += await self._count()  # EXPECT: atomicity-across-await
+
+    async def ok_recheck(self, rid):
+        # The fix shape: re-validate after the await.
+        if rid not in self._inflight:
+            data = await self._fetch(rid)
+            if rid not in self._inflight:
+                self._inflight[rid] = data
+        return self._inflight[rid]
+
+    async def ok_blind_store(self, rid):
+        # No pre-await decision: a blind store is last-wins by intent.
+        data = await self._fetch(rid)
+        self._inflight[rid] = data
+
+    async def ok_await_never_suspends(self, rid):
+        # _tally has no suspension point, so no other task can run
+        # between the read and the write.
+        if rid in self._inflight:
+            n = await self._tally()
+            self._inflight[rid] = n
+
+
+class Tally:
+    """Unannotated state: the conservative inference fallback."""
+
+    def __init__(self):
+        self._counts = {}
+        self._last_flush = 0.0
+
+    def bump(self, key):
+        self._counts[key] = self._counts.get(key, 0) + 1
+
+    async def flush(self, sink):
+        snapshot = dict(self._counts)
+        await sink.send(snapshot)
+        # _counts is inferred shared (mutated from bump AND flush,
+        # flush is async): clearing on the pre-await snapshot drops
+        # bumps that landed during the send.
+        self._counts.clear()  # EXPECT: atomicity-across-await
+        # _last_flush has a single writer outside __init__: not shared.
+        self._last_flush = 1.0
+
+    async def sanctioned(self, sink):
+        stamp = len(self._counts)
+        await sink.send(stamp)
+        # Deliberate last-wins, visibly suppressed.
+        # lint: disable-next=atomicity-across-await
+        self._counts["stamp"] = stamp

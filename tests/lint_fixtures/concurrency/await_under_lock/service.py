@@ -1,0 +1,52 @@
+"""await-under-lock fixtures: suspension points and blocking calls
+reached while a threading lock is held in async code; asyncio.Lock is
+exempt by design.
+"""
+
+import asyncio
+import threading
+import time
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self._data = {}
+
+    async def bad_await(self):
+        with self._lock:
+            await asyncio.sleep(0.01)  # EXPECT: await-under-lock
+
+    async def bad_blocking(self):
+        with self._lock:
+            time.sleep(0.01)  # EXPECT: await-under-lock
+
+    def _load(self):
+        time.sleep(0.05)
+        return dict(self._data)
+
+    async def bad_call_into_blocking(self):
+        with self._lock:
+            return self._load()  # EXPECT: await-under-lock
+
+    async def ok_asyncio_lock(self):
+        # Suspending under an asyncio.Lock is its design: waiters queue,
+        # the loop keeps running.
+        async with self._alock:
+            await asyncio.sleep(0.01)
+
+    async def ok_snapshot_then_await(self):
+        with self._lock:
+            snapshot = dict(self._data)
+        await asyncio.sleep(0.01)
+        return snapshot
+
+    async def ok_sync_critical_section(self):
+        with self._lock:
+            self._data["k"] = 1
+        return True
+
+    async def sanctioned(self):
+        with self._lock:
+            await asyncio.sleep(0)  # lint: disable=await-under-lock

@@ -1,0 +1,13 @@
+"""The tests/-scoping case: a test coroutine run by asyncio.run has no
+canceller, so its `finally` never races a pending CancelledError and
+the await-in-finally check skips files under tests/. The same file
+rooted at the fixture directory (rel without the tests/ prefix) IS
+flagged — the marker below is asserted under that root only.
+"""
+
+
+async def teardown(server):
+    try:
+        await server.serve()
+    finally:
+        await server.stop()  # EXPECT: cancellation-safety

@@ -1,0 +1,103 @@
+"""cancellation-safety fixtures: awaits in finally, swallowed
+CancelledError, unawaited cancels — plus every allowed idiom (shield,
+wait_for, cancel-then-reap, canceller-absorb, unknown-receiver cancel).
+"""
+
+import asyncio
+
+
+async def bad_finally(coro):
+    try:
+        return await coro
+    finally:
+        await asyncio.sleep(0.1)  # EXPECT: cancellation-safety
+
+
+async def ok_shielded(coro, cleanup):
+    try:
+        return await coro
+    finally:
+        await asyncio.shield(cleanup())
+
+
+async def ok_bounded(coro, cleanup):
+    try:
+        return await coro
+    finally:
+        await asyncio.wait_for(cleanup(), 1.0)
+
+
+async def ok_cancel_then_reap(tasks):
+    try:
+        await asyncio.sleep(1.0)
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def bad_swallow(fut):
+    try:
+        return await fut
+    except asyncio.CancelledError:  # EXPECT: cancellation-safety
+        return None
+
+
+async def bad_bare_except(fut):
+    try:
+        return await fut
+    except:  # noqa: E722  # EXPECT: cancellation-safety
+        return None
+
+
+async def ok_exception_only(fut):
+    # CancelledError derives from BaseException: Exception is safe.
+    try:
+        return await fut
+    except Exception:
+        return None
+
+
+async def ok_reraise(fut):
+    try:
+        return await fut
+    except asyncio.CancelledError:
+        raise
+
+
+async def ok_canceller_absorb(task):
+    # Absorbing the CancelledError you injected yourself is the reap.
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+
+
+async def bad_unawaited_cancel():
+    task = asyncio.create_task(asyncio.sleep(5))
+    task.cancel()  # EXPECT: cancellation-safety
+    return True
+
+
+async def ok_cancel_then_await():
+    task = asyncio.create_task(asyncio.sleep(5))
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+
+
+async def ok_cancel_unknown_receiver(runner):
+    # `runner` may be a non-task with a synchronous cancel(): receivers
+    # of unknown type are skipped rather than guessed at.
+    runner.cancel()
+    await asyncio.sleep(0)
+
+
+async def sanctioned(fut):
+    try:
+        return await fut
+    except BaseException:  # lint: disable=cancellation-safety
+        return None

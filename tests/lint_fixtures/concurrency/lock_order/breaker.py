@@ -1,0 +1,92 @@
+"""lock-order fixtures: re-entrance (direct, via callee, via callback)
+and acquisition-order cycles. The Breaker/Pool pair below is the PR-13
+single-thread self-deadlock in miniature: a callback fired under a
+non-reentrant lock whose body re-enters the same lock class through a
+property on a *sibling* instance.
+"""
+
+import threading
+
+
+class Breaker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cb = None
+        self._state = "closed"
+
+    def set_state_callback(self, cb):
+        self._cb = cb
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._state
+
+    def _transition(self, new):
+        with self._lock:
+            self._state = new
+            cb = self._cb
+            if cb is not None:
+                cb("closed", new)  # EXPECT: lock-order
+
+
+class Pool:
+    def __init__(self, a: "Breaker", b: "Breaker"):
+        self.a = a
+        self.b = b
+        a.set_state_callback(self._on_change)
+
+    def _on_change(self, old, new):
+        # Reads the sibling breaker's live locked state: lock identity
+        # is per declaration site, so this re-enters Breaker._lock.
+        return self.b.state
+
+
+class Recount:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rlock = threading.RLock()
+
+    def bad_direct(self):
+        with self._lock:
+            with self._lock:  # EXPECT: lock-order
+                pass
+
+    def bad_via_callee(self):
+        with self._lock:
+            self._helper()  # EXPECT: lock-order
+
+    def _helper(self):
+        with self._lock:
+            pass
+
+    def ok_rlock(self):
+        with self._rlock:
+            with self._rlock:  # reentrant by design
+                pass
+
+    def ok_disjoint(self):
+        with self._lock:
+            pass
+        with self._rlock:
+            pass
+
+    def sanctioned(self):
+        with self._lock:
+            self._helper()  # lint: disable=lock-order
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:  # EXPECT: lock-order
+                pass
+
+    def reverse(self):
+        with self._b:
+            with self._a:  # EXPECT: lock-order
+                pass

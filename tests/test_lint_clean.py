@@ -14,12 +14,25 @@ from pathlib import Path
 
 from distributed_lms_raft_llm_tpu.analysis import all_rules, run_lint
 from distributed_lms_raft_llm_tpu.analysis.core import (
+    Source,
     iter_sources,
     repo_root,
 )
 from distributed_lms_raft_llm_tpu.analysis.project import Project
+from distributed_lms_raft_llm_tpu.analysis.rules.atomicity_across_await import (
+    AtomicityAcrossAwaitRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.await_under_lock import (
+    AwaitUnderLockRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.cancellation_safety import (
+    CancellationSafetyRule,
+)
 from distributed_lms_raft_llm_tpu.analysis.rules.deadline_flow import (
     DeadlineFlowRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.lock_order import (
+    LockOrderRule,
 )
 from distributed_lms_raft_llm_tpu.analysis.rules.metrics_registry import (
     MetricsRegistryRule,
@@ -69,6 +82,10 @@ def test_rule_set_covers_the_demonstrated_bug_classes():
         "program-inventory",         # PR-6: jit entry points vs manifest
         "state-machine-determinism",  # PR-18: replica-diverging appliers
         "wire-taint",                # PR-18: unverified wire input at sinks
+        "lock-order",                # PR-13: breaker-callback self-deadlock
+        "atomicity-across-await",    # event-loop TOCTOU (shutdown races)
+        "await-under-lock",          # threading lock held across a yield
+        "cancellation-safety",       # teardown that loses CancelledError
     ):
         assert required in names, f"rule {required} missing from the catalog"
 
@@ -610,21 +627,127 @@ def test_secret_equality_compare_fails_lint():
     )
 
 
+# ------------------------------------------- concurrency reversion pins
+
+
+BATCHER = "distributed_lms_raft_llm_tpu/engine/batcher.py"
+TRANSPORT = "distributed_lms_raft_llm_tpu/raft/grpc_transport.py"
+RESILIENCE = "distributed_lms_raft_llm_tpu/utils/resilience.py"
+METRICS_IMPL = "distributed_lms_raft_llm_tpu/utils/metrics.py"
+
+
+def test_pr13_breaker_callback_deadlock_reconstruction_fails_lint():
+    """The PR-13 incident, reconstructed: make _on_breaker_change read
+    the live (locked) state_code() of a sibling breaker again instead of
+    the cached code. The interprocedural chain — transition fires the
+    callback under CircuitBreaker._lock, the callback's lockset (via the
+    sibling's state property) re-enters the same declaration-site lock —
+    must fail lock-order, with the dynamic callback invocation site
+    among the findings."""
+    project = _project_with_patch(POOL, (
+        "self._breaker_codes[node.index] = CircuitBreaker._STATE_CODES[new]",
+        "self._breaker_codes[node.index] = node.breaker.state_code()",
+    ))
+    findings = LockOrderRule().check_project(project)
+    assert findings, (
+        "re-reading live breaker state from the state-change callback "
+        "must fail lock-order"
+    )
+    assert any(
+        f.path == RESILIENCE and "cb(...)" in f.message for f in findings
+    ), "the callback invocation under CircuitBreaker._lock must be flagged"
+
+
+def test_await_under_threading_lock_fails_lint():
+    """What a careless async refactor of Metrics would produce: a
+    suspension point inside the `with self._lock:` critical section.
+    Metrics._lock is a threading lock (OrderedLock), so the lock would
+    stay held across the yield and every other task touching metrics
+    blocks the loop thread."""
+    project = _project_with_patch(METRICS_IMPL, (
+        "    def set_gauge(self",
+        "    async def render_async(self):\n"
+        "        with self._lock:\n"
+        "            await asyncio.sleep(0)\n"
+        "            return dict(self._gauges)\n"
+        "\n"
+        "    def set_gauge(self",
+    ))
+    findings = [
+        f for f in AwaitUnderLockRule().check_project(project)
+        if f.path == METRICS_IMPL
+    ]
+    assert findings, (
+        "an await inside a threading-lock critical section must fail "
+        "await-under-lock"
+    )
+
+
+def test_forgotten_cancel_turns_absorb_into_swallow_fails_lint():
+    """The canceller-absorb allowance is precise: drop the .cancel()
+    call from the batcher's close() and the same `except CancelledError:
+    pass` becomes a genuine cancellation swallow (awaiting a task it
+    never cancelled), which must fail cancellation-safety."""
+    root = repo_root()
+    path = root / BATCHER
+    text = path.read_text()
+    old = "            self._runner.cancel()\n"
+    assert old in text, "pin is stale: batcher close() no longer cancels"
+    src = Source(path, root=root, text=text.replace(old, "", 1))
+    rule = CancellationSafetyRule()
+    findings = [
+        f for f in rule.check(src)
+        if not src.suppressed(f.rule, f.line) and "swallows" in f.message
+    ]
+    assert findings, (
+        "an un-cancelled CancelledError absorb must fail "
+        "cancellation-safety"
+    )
+
+
+def test_reverting_transport_close_snapshot_fix_fails_lint():
+    """Revert the grpc transport's snapshot-then-clear shutdown fix
+    (clear() back after the awaits) and the clear once again acts on a
+    pre-await read of a live dict — atomicity-across-await must flag
+    it."""
+    project = _project_with_patch(TRANSPORT, (
+        "        channels = list(self._channels.values())\n"
+        "        self._channels.clear()\n"
+        "        self._stubs.clear()\n"
+        "        for channel in channels:\n"
+        "            await channel.close()\n",
+        "        for channel in self._channels.values():\n"
+        "            await channel.close()\n"
+        "        self._channels.clear()\n"
+        "        self._stubs.clear()\n",
+    ))
+    findings = [
+        f for f in AtomicityAcrossAwaitRule().check_project(project)
+        if f.path == TRANSPORT and "_channels" in f.message
+    ]
+    assert findings, (
+        "clearing the channel dict after awaiting closes must fail "
+        "atomicity-across-await"
+    )
+
+
 # ------------------------------------------------------ lint wall budget
 
 
 def test_full_lint_run_stays_within_wall_budget():
     """The suite runs the full rule set several times (here, the CLI
-    test, fixture tests); the shared AST cache keeps that cheap. Budget
-    chosen ~4x the measured cold time so CI noise can't flake it, while
-    an accidental O(files^2) regression still fails loudly."""
+    test, fixture tests); the shared AST cache keeps that cheap. A cold
+    full run measures low-20s on a loaded dev box (the interprocedural
+    rules build a whole-tree call graph + concurrency engine); 30 s
+    leaves noise headroom while an accidental O(files^2) regression —
+    which blows past minutes — still fails loudly."""
     import time
 
     t0 = time.monotonic()
     findings = run_lint()
     dt = time.monotonic() - t0
     assert not findings
-    assert dt < 20.0, f"full lint run took {dt:.1f}s (budget 20s)"
+    assert dt < 30.0, f"full lint run took {dt:.1f}s (budget 30s)"
 
 
 # --------------------------------------------------- registry <-> README

@@ -24,6 +24,18 @@ from distributed_lms_raft_llm_tpu.analysis.project import Project
 from distributed_lms_raft_llm_tpu.analysis.rules.async_blocking import (
     BlockingInAsyncRule,
 )
+from distributed_lms_raft_llm_tpu.analysis.rules.atomicity_across_await import (
+    AtomicityAcrossAwaitRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.await_under_lock import (
+    AwaitUnderLockRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.cancellation_safety import (
+    CancellationSafetyRule,
+)
+from distributed_lms_raft_llm_tpu.analysis.rules.lock_order import (
+    LockOrderRule,
+)
 from distributed_lms_raft_llm_tpu.analysis.rules.config_consistency import (
     ConfigConsistencyRule,
 )
@@ -302,6 +314,111 @@ def test_program_inventory_fixture():
     run_project_rule(
         ProgramInventoryRule(scan_prefixes=("",), manifest_rel="inventory.py"),
         "program_inventory", base=ABSINT,
+    )
+
+
+# --------------------------------------------------------- concurrency
+
+CONC = FIXTURES / "concurrency"
+
+
+def test_atomicity_across_await_fixture():
+    # Annotated + inferred shared attrs, the true-suspension model
+    # (awaiting a never-suspending coroutine is not a window), the
+    # re-read/blind-store true negatives, and a sanctioned last-wins.
+    run_project_rule(
+        AtomicityAcrossAwaitRule(), "atomicity_across_await", base=CONC
+    )
+
+
+def test_lock_order_fixture():
+    # Direct re-entrance, re-entrance through a callee's lockset, the
+    # PR-13 callback shape (dynamic call under a lock + registered
+    # callback whose lockset re-enters it through a sibling instance's
+    # property), and an A->B / B->A acquisition-order cycle; RLock
+    # re-entry and the suppressed case stay silent.
+    run_project_rule(LockOrderRule(), "lock_order", base=CONC)
+
+
+def test_await_under_lock_fixture():
+    # Suspension, blocking intrinsic, and a call into a BLOCKING-effect
+    # path, each under a threading lock; asyncio.Lock and the
+    # snapshot-then-await shape stay silent.
+    run_project_rule(AwaitUnderLockRule(), "await_under_lock", base=CONC)
+
+
+def test_cancellation_safety_fixture():
+    # Per-file rule, but rooted at the case dir so the rel does not
+    # carry the tests/ prefix (which scopes out the finally check).
+    case = CONC / "cancellation_safety"
+    src = Source(case / "worker.py", root=case)
+    rule = CancellationSafetyRule()
+    flagged = {
+        f.line for f in rule.check(src) if not src.suppressed(f.rule, f.line)
+    }
+    expected = expected_lines(src, rule.name)
+    assert flagged == expected, (
+        f"cancellation-safety: flagged {sorted(flagged)} but expected "
+        f"{sorted(expected)} (false positives: {sorted(flagged - expected)}, "
+        f"misses: {sorted(expected - flagged)})"
+    )
+
+
+def test_cancellation_safety_finally_check_scopes_out_tests():
+    """The same file flips between flagged and silent purely on whether
+    its rel sits under tests/ — test teardown coroutines run under
+    asyncio.run with no canceller, so their finally blocks never race a
+    pending CancelledError."""
+    case = CONC / "cancellation_safety"
+    path = case / "teardown_in_tests.py"
+    rule = CancellationSafetyRule()
+
+    as_project_file = Source(path, root=case)
+    assert {f.line for f in rule.check(as_project_file)} == expected_lines(
+        as_project_file, rule.name
+    ), "rooted outside tests/, the finally await must be flagged"
+
+    as_test_file = Source(path, root=REPO)
+    assert as_test_file.rel.startswith("tests/")
+    assert rule.check(as_test_file) == [], (
+        "rooted under tests/, the finally check must scope out"
+    )
+
+
+def test_subset_runs_scope_concurrency_reports_not_analysis(tmp_path):
+    """The --changed contract for the concurrency rules: a subset run
+    still analyzes the FULL tree (locksets over half a repo prove
+    nothing) but reports only into the requested files."""
+    from distributed_lms_raft_llm_tpu.analysis import run_lint
+
+    pkg = tmp_path / "distributed_lms_raft_llm_tpu"
+    pkg.mkdir()
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "tests").mkdir()
+    reenter = (
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def reenter(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    )
+    (pkg / "a.py").write_text(reenter)
+    (pkg / "b.py").write_text(reenter.replace("class C", "class D"))
+
+    full = run_lint(rules=[LockOrderRule()], root=tmp_path)
+    assert {f.path for f in full} == {
+        "distributed_lms_raft_llm_tpu/a.py",
+        "distributed_lms_raft_llm_tpu/b.py",
+    }, "full runs must report the re-entrance in both files"
+
+    scoped = run_lint(
+        paths=[pkg / "b.py"], rules=[LockOrderRule()], root=tmp_path
+    )
+    assert {f.path for f in scoped} == {"distributed_lms_raft_llm_tpu/b.py"}, (
+        "a subset run must report only into the requested files"
     )
 
 
