@@ -26,6 +26,14 @@ Mechanics (analysis/project.py):
   timeout *expression* (`deadline.timeout(cap=...)`, `max(floor, ...)`)
   is the fix shape and never flags, so the rule cannot pester correct
   code into suppressions;
+- server-streaming egress is held to the same contract through a second
+  shape: a CamelCase call consumed as an **async-for iterable**
+  (`async for chunk in stub.StreamLLMAnswer(...)`). A stream with NO
+  `timeout=` at all is a finding there — an open stream outlives any
+  client budget silently, and the async-for context rules out protobuf
+  constructors, so the missing-keyword check that would be too noisy on
+  plain calls is sound on this shape. Literal timeouts on streaming
+  calls are caught by the ordinary literal check above;
 - the async functions of the router/pool egress modules
   (`DEFAULT_EGRESS_ROOTS`, e.g. `lms/tutoring_pool.py`) are roots in
   their own right: they run per-request behind `self.pool.forward(...)`
@@ -111,6 +119,31 @@ class DeadlineFlowRule(ProjectRule):
             if fn.qname not in reachable:
                 continue
             for node in ast.walk(fn.node):
+                if isinstance(node, ast.AsyncFor) \
+                        and isinstance(node.iter, ast.Call):
+                    # Server-streaming egress consumed as an async-for
+                    # iterable: a stream opened with NO timeout at all
+                    # runs unbounded past any client budget. (A literal
+                    # timeout on the same call is caught by the plain
+                    # Call branch below.)
+                    call = node.iter
+                    rpc = _stub_egress_name(call)
+                    if rpc and not any(kw.arg == "timeout"
+                                       for kw in call.keywords):
+                        key = (fn.rel, call.lineno, call.col_offset)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        findings.append(self.finding(
+                            fn.src, call,
+                            f"async for ... in {rpc}(...) opens a "
+                            "server stream with no timeout — the stream "
+                            "outlives the client's propagated Deadline "
+                            "budget and can pin this server "
+                            "indefinitely; pass timeout=Deadline."
+                            "timeout(cap=...) on the stream call",
+                        ))
+                    continue
                 if not isinstance(node, ast.Call):
                     continue
                 rpc = _stub_egress_name(node)

@@ -30,7 +30,12 @@ Mechanics (analysis/project.py, shared with deadline-flow):
   matched: a CamelCase call carrying a `timeout=` keyword whose handle
   is awaited *later* (the fleet router holds the call object to read
   the `x-served-by` response trailer) — constructors never pass
-  `timeout=`, so they stay out of scope;
+  `timeout=`, so they stay out of scope. A third shape covers
+  server-streaming egress: a CamelCase call consumed as an **async-for
+  iterable** (`async for chunk in stub.StreamLLMAnswer(...)`) — the
+  iteration context rules out constructors even without a `timeout=`
+  keyword, so a metadata-dropping stream forward cannot hide from the
+  rule by dropping the timeout too;
 - the async functions of the router/pool egress modules
   (`DEFAULT_EGRESS_ROOTS`, e.g. `lms/tutoring_pool.py`) are roots in
   their own right: they run per-request behind `self.pool.forward(...)`
@@ -141,6 +146,16 @@ class TracePropagationRule(ProjectRule):
                 call = None
                 if isinstance(node, ast.Await):
                     call = _awaited_stub_egress(node)
+                elif isinstance(node, ast.AsyncFor) \
+                        and isinstance(node.iter, ast.Call):
+                    # Server-streaming egress: the stream call is never
+                    # awaited directly — its chunks arrive through the
+                    # async-for — but every chunk still rides the hop
+                    # this call's metadata opened.
+                    func = node.iter.func
+                    if isinstance(func, ast.Attribute) \
+                            and func.attr[:1].isupper():
+                        call = node.iter
                 elif isinstance(node, ast.Call):
                     func = node.func
                     if (isinstance(func, ast.Attribute)

@@ -19,6 +19,8 @@ deadline, never a hang.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 import logging
 import random
 import time
@@ -53,6 +55,26 @@ RETRYABLE = {
 
 class NoLeader(Exception):
     pass
+
+
+@dataclasses.dataclass
+class StreamAnswer:
+    """One streamed ask_llm's outcome, shaped for unary parity.
+
+    `success`/`response` match `ask_llm`'s QueryResponse contract
+    (`response` is the stripped full answer), so call sites can treat
+    the two paths interchangeably. The streaming-only evidence rides
+    along: chunk/resume counts, time-to-first-token, and the digest
+    verdict (`digest_ok` is None when the stream ended on a failure or
+    degraded chunk that carries no digest)."""
+
+    success: bool
+    response: str
+    chunks: int = 0
+    resumes: int = 0
+    ttft_s: Optional[float] = None
+    digest: str = ""
+    digest_ok: Optional[bool] = None
 
 
 class LMSClient:
@@ -552,3 +574,133 @@ class LMSClient:
             attempt_cap_s=None,
             route="ask_llm", trace_id=rid,
         )
+
+    def ask_llm_stream(
+        self, query: str, *, session_id: str = "",
+        budget_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+    ) -> StreamAnswer:
+        """Streamed ask_llm under the resumable-stream contract.
+
+        The client tracks the last delivered token offset; any mid-stream
+        failure (leader loss, a serving-node kill behind the LMS, a
+        breaker opening) re-discovers the leader and RESUMES at that
+        offset via `resume_offset` — tokens already delivered are never
+        re-requested, and a resumed stream splices gap-free because the
+        server regenerates deterministically and skips the delivered
+        prefix. Chunks are validated client-side: pure duplicates are
+        dropped, an offset gap fails the attempt (retryable — the resend
+        starts at our offset), so the delivered text is monotone,
+        gap-free, and duplicate-free by construction.
+
+        `session_id` threads conversational turns: the server keys
+        tutoring-node affinity on it and splices turn N's transcript as
+        a shared KV prefix for turn N+1.
+
+        The final chunk's digest is checked against sha256 of the
+        stripped full answer (exactly what unary `ask_llm` returns), so
+        `digest_ok=True` proves the streamed answer is bit-identical to
+        the unary one end to end — across resumes included."""
+        rid = request_id or self._request_id()
+        deadline = Deadline.after(budget_s or self.llm_timeout_s)
+        delivered = 0
+        parts: List[str] = []
+        resumes = 0
+        chunks = 0
+        ttft_s: Optional[float] = None
+        with get_tracer().trace("client.ask_llm_stream",
+                                trace_id=rid) as root:
+            t_start = time.monotonic()
+            last_error: Optional[Exception] = None
+            avoid: Optional[str] = None
+            lane = self._home_group()
+            for attempt in range(self.rpc_retries + 1):
+                if deadline.expired:
+                    break
+                addr = None
+                if delivered > 0 and attempt > 0:
+                    resumes += 1
+                try:
+                    addr = self.discover_leader(
+                        force=attempt > 0, deadline=deadline,
+                        avoid=avoid, group=lane,
+                    )
+                    stub = rpc.LMSStub(self._channel(addr))
+                    timeout = max(0.001, deadline.timeout(cap=None))
+                    final = None
+                    call = stub.StreamLLMAnswer(
+                        lms_pb2.StreamRequest(
+                            token=self.token or "", query=query,
+                            session_id=session_id,
+                            resume_offset=delivered,
+                        ),
+                        timeout=timeout,
+                        metadata=self._md(deadline, request_id=rid),
+                    )
+                    for chunk in call:
+                        chunks += 1
+                        if chunk.count > 0 and chunk.success:
+                            end = chunk.offset + chunk.count
+                            if end <= delivered:
+                                continue  # pure duplicate: drop
+                            if chunk.offset != delivered:
+                                # A gap (or mid-chunk overlap) breaks
+                                # the monotone contract: fail the
+                                # attempt; the resume re-requests from
+                                # OUR offset, never trusts the gap.
+                                raise grpc.RpcError()
+                            if ttft_s is None:
+                                ttft_s = time.monotonic() - t_start
+                            parts.append(chunk.text)
+                            delivered = end
+                        if chunk.final:
+                            final = chunk
+                            break
+                    if final is None:
+                        # Stream ended cleanly but without a final chunk
+                        # (server died between chunks): resume.
+                        raise grpc.RpcError()
+                    full = "".join(parts)
+                    digest_ok: Optional[bool] = None
+                    if final.digest:
+                        digest_ok = (
+                            hashlib.sha256(full.strip().encode())
+                            .hexdigest() == final.digest
+                        )
+                    text = (full.strip() if delivered > 0
+                            else final.text)
+                    return StreamAnswer(
+                        success=final.success, response=text,
+                        chunks=chunks, resumes=resumes,
+                        ttft_s=ttft_s, digest=final.digest,
+                        digest_ok=digest_ok,
+                    )
+                except grpc.RpcError as e:
+                    last_error = e
+                    code = e.code() if hasattr(e, "code") else None
+                    if code is not None and code not in RETRYABLE:
+                        raise
+                    if addr is not None:
+                        self.evict_leader_hint(addr, group=lane)
+                        avoid = addr
+                    log.info("stream attempt failed (%s) at offset %d; "
+                             "re-resolving leader", code, delivered)
+                    if attempt >= self.rpc_retries:
+                        break
+                    sleep_s = min(
+                        jittered_backoff(
+                            attempt, base_s=self.backoff_base_s,
+                            cap_s=self.backoff_max_s, rng=self._rng,
+                        ),
+                        deadline.remaining(),
+                    )
+                    if sleep_s > 0:
+                        time.sleep(sleep_s)
+            if last_error is not None:
+                root.flag(FLAG_ERROR)
+                raise last_error
+            root.flag(FLAG_DEADLINE)
+            raise DeadlineExpired(
+                f"stream budget ({budget_s or self.llm_timeout_s:.1f}s) "
+                f"exhausted at offset {delivered}"
+            )

@@ -152,6 +152,12 @@ class TutoringFleetConfig:
     #                                 rejoined/added node (ramps to 1.0
     #                                 over warmup_s)
     health_poll_s: float = 1.0      # router health-poll cadence
+    stream_stall_s: float = 2.0     # streaming forwards: max silence
+    #                                 between chunks before the stream is
+    #                                 declared wedged — the breaker takes
+    #                                 the failure and the pool resumes the
+    #                                 stream at the delivered offset on
+    #                                 the spill node; 0 = no stall watch
 
     def __post_init__(self) -> None:
         if self.health_addresses and len(self.health_addresses) != len(
@@ -175,6 +181,33 @@ class TutoringFleetConfig:
             raise ValueError(
                 "[tutoring_fleet] queue_spill_depth must be >= 1"
             )
+        if self.stream_stall_s < 0:
+            raise ValueError(
+                "[tutoring_fleet] stream_stall_s must be >= 0"
+            )
+
+
+@dataclasses.dataclass
+class SessionsConfig:
+    """[sessions] — multi-turn tutoring sessions (streaming path).
+
+    One section because the knobs compose into one policy: a session id
+    rides the routing affinity key (turn N+1 lands on the node already
+    holding turn N's KV blocks), the serving node keeps the session
+    transcript for `ttl_s` and publishes it into the radix prefix cache
+    under a session pin of the same TTL, and `max_sessions` bounds what
+    one node retains (oldest-idle sessions are dropped first — their
+    pinned blocks fall back to plain LRU)."""
+
+    ttl_s: float = 600.0     # session transcript + prefix-pin lifetime;
+    #                          refreshed on every turn
+    max_sessions: int = 256  # per-node live-session cap (0 = unbounded)
+
+    def __post_init__(self) -> None:
+        if self.ttl_s <= 0:
+            raise ValueError("[sessions] ttl_s must be > 0")
+        if self.max_sessions < 0:
+            raise ValueError("[sessions] max_sessions must be >= 0")
 
 
 @dataclasses.dataclass
@@ -369,6 +402,19 @@ class SimConfig:
     telemetry_sample_s: float = 0.25  # scrape/evaluate cadence of the
     #                               in-run telemetry loop (cluster /metrics
     #                               poll + burn-rate evaluation)
+    session_fraction: float = 0.25  # fraction of students that run a
+    #                               follow-up-question CHAIN (streamed,
+    #                               session-sticky, prefix-spliced turns)
+    #                               instead of independent one-shot asks;
+    #                               0 disables the conversational workload
+    session_turns: int = 3        # turns per follow-up chain (turn 1 cold,
+    #                               turns 2..N splice the session prefix)
+    session_ttl_s: float = 30.0   # sim-scale session pin TTL handed to the
+    #                               tutoring nodes' session stores
+    slo_turn_ttft_p95_s: float = 4.0  # per-turn time-to-first-token p95
+    #                               bound over streamed session turns —
+    #                               the latency SLO conversational turns
+    #                               are judged by (TTFT, not full-answer)
     lms_groups: int = 1           # Raft groups hosting the sharded LMS
     #                               state (lms/group_router.py); > 1 boots
     #                               the router + per-group Raft planes and
@@ -397,6 +443,13 @@ class SimConfig:
             raise ValueError("[sim] tutoring_nodes must be >= 1")
         if not 0.0 <= self.course_concentration <= 1.0:
             raise ValueError("[sim] course_concentration must be in [0, 1]")
+        if not 0.0 <= self.session_fraction <= 1.0:
+            raise ValueError("[sim] session_fraction must be in [0, 1]")
+        if self.session_turns < 1:
+            raise ValueError("[sim] session_turns must be >= 1")
+        if self.session_ttl_s <= 0 or self.slo_turn_ttft_p95_s <= 0:
+            raise ValueError("[sim] session_ttl_s and slo_turn_ttft_p95_s "
+                             "must be > 0")
 
 
 @dataclasses.dataclass
@@ -476,6 +529,9 @@ class AppConfig:
     tutoring_fleet: TutoringFleetConfig = dataclasses.field(
         default_factory=TutoringFleetConfig
     )
+    sessions: SessionsConfig = dataclasses.field(
+        default_factory=SessionsConfig
+    )
     sampling: SamplingConfig = dataclasses.field(default_factory=SamplingConfig)
     scoring: ScoringConfig = dataclasses.field(default_factory=ScoringConfig)
     gate: GateConfig = dataclasses.field(default_factory=GateConfig)
@@ -511,9 +567,9 @@ def load_config(path: str) -> AppConfig:
     with open(path, "rb") as fh:
         raw = tomllib.load(fh)
     unknown = set(raw) - {"cluster", "tutoring", "tutoring_fleet",
-                          "sampling", "scoring", "gate", "resilience",
-                          "groups", "storage", "sim", "tracing",
-                          "telemetry"}
+                          "sessions", "sampling", "scoring", "gate",
+                          "resilience", "groups", "storage", "sim",
+                          "tracing", "telemetry"}
     if unknown:
         raise ValueError(f"unknown section(s) {sorted(unknown)} in {path}")
 
@@ -530,6 +586,8 @@ def load_config(path: str) -> AppConfig:
         tutoring_fleet=_build(TutoringFleetConfig,
                               dict(raw.get("tutoring_fleet", {})),
                               "tutoring_fleet"),
+        sessions=_build(SessionsConfig, dict(raw.get("sessions", {})),
+                        "sessions"),
         sampling=_build(SamplingConfig, dict(raw.get("sampling", {})),
                         "sampling"),
         scoring=_build(ScoringConfig, dict(raw.get("scoring", {})),

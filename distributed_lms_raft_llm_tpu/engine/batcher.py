@@ -36,9 +36,10 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import re
 import time
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional, Tuple
+from typing import Any, AsyncIterator, Deque, Dict, List, Optional, Tuple
 
 from ..utils import metrics_registry as metric
 from ..utils.resilience import Deadline, DeadlineExpired, Overloaded
@@ -52,6 +53,77 @@ log = logging.getLogger(__name__)
 # code never branches on tracing; the enqueue time feeds the scoring
 # tenant's preemption-wait account (score_preempt_wait_ms).
 _Item = Tuple[str, Optional[Deadline], asyncio.Future, Any, Any, float]
+
+# ---------------------------------------------------------------- streaming
+#
+# Both queues expose `submit_stream()`: an async iterator of StreamDelta
+# feeding the StreamLLMAnswer wire path. The resumable-stream contract both
+# implementations honor:
+#
+# - offsets count TOKENS; within one logical stream they are monotone and
+#   gap-free (delta i+1 starts exactly where delta i ended);
+# - `resume_offset=K` asks for a stream whose first delta starts at token
+#   K: the engine regenerates deterministically and the text of tokens
+#   [0, K) is skipped, so a client that already holds K tokens' text can
+#   splice the tail without duplication;
+# - the final delta carries `full_text` — the COMPLETE answer from token 0
+#   — so the wire layer can digest it (the client verifies its spliced
+#   transcript against the digest; any resume divergence is caught there).
+#
+# PagedQueue streams live token progress off the engine's incremental
+# channel (`stream_snapshot`); BatchingQueue engines have no token channel,
+# so the completed answer is re-chunked with the deterministic splitter
+# below — same token boundaries on every node, which is what makes
+# cross-node resume offsets meaningful there too.
+
+# Tokens per delta on the BatchingQueue fallback path.
+STREAM_CHUNK_TOKENS = 8
+
+_STREAM_TOKEN_RE = re.compile(r"\s*\S+")
+
+
+def split_stream_tokens(text: str) -> List[str]:
+    """Deterministic whitespace-preserving tokenization for engines
+    without a native token stream. Concatenation identity:
+    ``''.join(split_stream_tokens(t)) == t`` for every t."""
+    toks = _STREAM_TOKEN_RE.findall(text)
+    consumed = sum(len(t) for t in toks)
+    if consumed < len(text):
+        tail = text[consumed:]
+        if toks:
+            toks[-1] += tail
+        else:
+            toks = [tail]
+    return toks
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamDelta:
+    """One increment of a streamed answer: the decoded text of tokens
+    [offset, offset + count). `full_text` is set on the final delta only
+    (the complete answer from token 0, digest source)."""
+
+    offset: int
+    count: int
+    text: str
+    final: bool
+    full_text: str = ""
+
+
+@dataclasses.dataclass
+class _StreamState:
+    """Per-stream emission state the PagedQueue runner advances between
+    engine steps. `abs_text` is the decoded text through `sent_tokens`
+    ABSOLUTE tokens (None until the resume skip is resolved); deltas are
+    emitted only at decode-prefix-stable boundaries — a snapshot whose
+    decode does not extend the already-emitted text verbatim is held
+    back until more tokens stabilize it."""
+
+    q: "asyncio.Queue[StreamDelta]"
+    skip: int = 0
+    rid: Optional[int] = None
+    sent_tokens: int = 0
+    abs_text: Optional[str] = None
 
 
 def _observe_program_times(metrics, entries) -> None:
@@ -218,6 +290,39 @@ class BatchingQueue:
              time.monotonic())
         )
         return await fut
+
+    async def submit_stream(
+        self, prompt: str,
+        deadline: Optional[Deadline] = None,
+        span: Any = None,
+        resume_offset: int = 0,
+        session: Optional[Tuple[str, float]] = None,
+    ) -> AsyncIterator[StreamDelta]:
+        """Streaming facade over batch engines without an incremental
+        token channel: the completed answer is delivered as deterministic
+        token-chunk deltas (see the module streaming contract). `session`
+        is accepted for interface parity and ignored — transcript KV
+        pinning needs the paged engine's prefix cache."""
+        answer = await self.submit(prompt, deadline=deadline, span=span)
+        toks = split_stream_tokens(answer)
+        n = len(toks)
+        i = min(max(0, int(resume_offset)), n)
+        if i >= n:
+            yield StreamDelta(offset=n, count=0, text="", final=True,
+                              full_text=answer)
+            return
+        while i < n:
+            j = min(i + STREAM_CHUNK_TOKENS, n)
+            final = j >= n
+            yield StreamDelta(
+                offset=i, count=j - i, text="".join(toks[i:j]),
+                final=final, full_text=answer if final else "",
+            )
+            i = j
+            if not final:
+                # A real yield point between deltas: chunks of concurrent
+                # streams interleave on the wire instead of bursting.
+                await asyncio.sleep(0)
 
     async def _collect(self, first: _Item) -> List[_Item]:
         """Gather companions for the (already-popped) first request."""
@@ -423,6 +528,13 @@ class PagedQueue:
         # and reaps happen on the runner coroutine between steps.
         self._incoming: asyncio.Queue[_Item] = asyncio.Queue()  # guarded-by: event-loop
         self._futures: Dict[int, asyncio.Future] = {}  # guarded-by: event-loop
+        # Streaming registry: future -> stream state while the request
+        # waits for admission (no rid yet), re-keyed to rid -> state at
+        # _admit. Session turns ride the same handoff (future ->
+        # (session_id, pin ttl), applied to the engine at _admit).
+        self._stream_reg: Dict[asyncio.Future, _StreamState] = {}  # guarded-by: event-loop
+        self._streams: Dict[int, _StreamState] = {}  # guarded-by: event-loop
+        self._session_reg: Dict[asyncio.Future, Tuple[str, float]] = {}  # guarded-by: event-loop
         # rid -> deadline for requests sitting in the ENGINE's pending list
         # (handed over by _admit but no slot yet — prefill hasn't run).
         self._pending_deadlines: Dict[int, Deadline] = {}  # guarded-by: event-loop
@@ -481,11 +593,17 @@ class PagedQueue:
         for fut in self._futures.values():
             if not fut.done():
                 fut.set_exception(RuntimeError("paged queue closed"))
+        for fut in self._stream_reg:
+            if not fut.done():
+                fut.set_exception(RuntimeError("paged queue closed"))
         for entry in self._spans.values():
             entry.qspan.end()
         self._futures.clear()
         self._pending_deadlines.clear()
         self._spans.clear()
+        self._stream_reg.clear()
+        self._streams.clear()
+        self._session_reg.clear()
 
     async def submit(self, prompt: str,
                      deadline: Optional[Deadline] = None,
@@ -507,6 +625,88 @@ class PagedQueue:
              time.monotonic())
         )
         return await fut
+
+    async def submit_stream(
+        self, prompt: str,
+        deadline: Optional[Deadline] = None,
+        span: Any = None,
+        resume_offset: int = 0,
+        session: Optional[Tuple[str, float]] = None,
+    ) -> AsyncIterator[StreamDelta]:
+        """Incremental token-yield stream: deltas are emitted as the
+        engine's continuous-batching steps produce tokens (see the
+        module streaming contract for offset/resume semantics).
+        `session=(session_id, ttl_s)` marks the request as a tutoring
+        session turn: its transcript is published into the radix cache
+        and session-pinned at finish."""
+        if self._closed:
+            raise RuntimeError("paged queue is closed")
+        if deadline is not None and deadline.expired:
+            self._inc("shed_expired")
+            raise DeadlineExpired("expired before enqueue")
+        if self.max_queue and self.waiting >= self.max_queue:
+            self._inc("shed_overload")
+            raise Overloaded(
+                f"paged admission queue full ({self.waiting} waiting)"
+            )
+        span = span if span is not None else NULL_SPAN
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        st = _StreamState(q=asyncio.Queue(),
+                          skip=max(0, int(resume_offset)))
+        self._stream_reg[fut] = st
+        if session is not None:
+            self._session_reg[fut] = session
+        await self._incoming.put(
+            (prompt, deadline, fut, span, span.child("queue.wait"),
+             time.monotonic())
+        )
+        try:
+            while True:
+                getter = asyncio.ensure_future(st.q.get())
+                await asyncio.wait({getter, fut},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                if getter.done() and not getter.cancelled():
+                    # Already-done future: result() is immediate.
+                    delta = getter.result()  # lint: disable=no-blocking-in-async
+                    yield delta
+                    if delta.final:
+                        return
+                    continue
+                getter.cancel()
+                await asyncio.gather(getter, return_exceptions=True)
+                # The result future resolved first: propagate its failure,
+                # or drain deltas the runner pushed in the same iteration.
+                exc = fut.exception()
+                if exc is not None:
+                    raise exc
+                while not st.q.empty():
+                    delta = st.q.get_nowait()
+                    yield delta
+                    if delta.final:
+                        return
+                # Defensive: the engine resolved the answer without the
+                # stream channel reporting a final (shouldn't happen on
+                # the paged engine) — degrade to one final delta.
+                # fut resolved first (FIRST_COMPLETED, getter not done),
+                # so result() is immediate.
+                text = fut.result()  # lint: disable=no-blocking-in-async
+                sent = st.abs_text or ""
+                yield StreamDelta(
+                    offset=st.sent_tokens, count=0,
+                    text=text[len(sent):] if text.startswith(sent) else "",
+                    final=True, full_text=text,
+                )
+                return
+        finally:
+            self._stream_reg.pop(fut, None)
+            self._session_reg.pop(fut, None)
+            if st.rid is not None:
+                self._streams.pop(st.rid, None)
+                unwatch = getattr(self.engine, "stream_unwatch", None)
+                if unwatch is not None:
+                    unwatch(st.rid)
+            if fut.done() and not fut.cancelled():
+                fut.exception()  # consumed above; mark retrieved
 
     def _note_preempt(self, t_enq: float) -> None:
         """Charge an interactive arrival that landed inside the last
@@ -531,6 +731,8 @@ class PagedQueue:
             self._inc("shed_expired")
             qspan.end()
             span.flag(FLAG_DEADLINE)
+            self._stream_reg.pop(fut, None)
+            self._session_reg.pop(fut, None)
             if not fut.done():
                 fut.set_exception(
                     DeadlineExpired("expired while queued; prefill skipped")
@@ -543,6 +745,18 @@ class PagedQueue:
                                      self._prog_snapshot())
         if deadline is not None:
             self._pending_deadlines[rid] = deadline
+        st = self._stream_reg.pop(fut, None)
+        if st is not None:
+            st.rid = rid
+            self._streams[rid] = st
+            watch = getattr(self.engine, "stream_watch", None)
+            if watch is not None:
+                watch(rid)
+        session = self._session_reg.pop(fut, None)
+        if session is not None:
+            mark = getattr(self.engine, "mark_session", None)
+            if mark is not None:
+                mark(rid, session[0], session[1])
 
     def _prog_snapshot(self) -> Dict[str, Tuple[float, float]]:
         return {k: (v[0], v[1]) for k, v in self._prog_cum.items()}
@@ -616,6 +830,10 @@ class PagedQueue:
                     self._futures.clear()
                     self._pending_deadlines.clear()
                     self._spans.clear()
+                    # Stream consumers observe the failure through their
+                    # result future; drop the emission states (reset()
+                    # below clears the engine-side watch set).
+                    self._streams.clear()
                     # A failed step may have donated the live state away;
                     # rebuild it or every later request fails too.
                     self.engine.reset()
@@ -707,6 +925,15 @@ class PagedQueue:
                                 self._prefix_hit_cum
                                 / self._prefix_prompt_cum,
                             )
+                    sess = getattr(self.engine, "session_pin_stats",
+                                   lambda: None)()
+                    if sess is not None:
+                        # Session residency: blocks held by live
+                        # transcript pins (TTL-expired pins are dropped
+                        # inside the stats call).
+                        _n_sessions, pinned = sess
+                        self.metrics.set_gauge("session_pinned_blocks",
+                                               float(pinned))
                     spec = getattr(self.engine, "pop_spec_stats",
                                    lambda: None)()
                     if spec is not None:
@@ -723,12 +950,83 @@ class PagedQueue:
                             self.metrics.inc(
                                 "spec_accepted_tokens", emitted - windows
                             )
+                # Stream emission BEFORE future resolution: a consumer
+                # woken by its future always finds the final delta (and
+                # any last partials) already queued.
+                self._emit_stream_progress(done)
                 for rid, text in done:
                     self._pending_deadlines.pop(rid, None)
                     self._finish_span(rid)
                     f = self._futures.pop(rid, None)
                     if f is not None and not f.done():
                         f.set_result(text)
+
+    def _emit_stream_progress(
+        self, done: List[Tuple[int, str]]
+    ) -> None:
+        """Advance every registered stream after an engine step: finals
+        for requests that completed this step (their token lists drained
+        from the engine's watch channel), then partial deltas for the
+        still-live ones from the incremental snapshot."""
+        if not self._streams:
+            return
+        finals: Dict[int, List[int]] = {}
+        popf = getattr(self.engine, "pop_final_tokens", None)
+        if popf is not None:
+            finals = popf()
+        done_map = dict(done)
+        for rid in [r for r in self._streams if r in done_map]:
+            st = self._streams.pop(rid)
+            self._push_final(st, finals.get(rid), done_map[rid])
+        live = list(self._streams)
+        if not live:
+            return
+        snap = getattr(self.engine, "stream_snapshot", None)
+        if snap is None:
+            return
+        for rid, toks in snap(live).items():
+            self._push_partial(self._streams[rid], toks)
+
+    def _push_partial(self, st: _StreamState, toks: List[int]) -> None:
+        n = len(toks)
+        if st.abs_text is None:
+            # Resume skip unresolved: wait until the regeneration reaches
+            # the resume offset, then anchor the emitted-text position at
+            # the skipped prefix's decoded length.
+            if n < st.skip:
+                return
+            st.sent_tokens = st.skip
+            st.abs_text = (self.engine.decode_tokens(toks[:st.skip])
+                           if st.skip else "")
+        if n <= st.sent_tokens:
+            return
+        full = self.engine.decode_tokens(toks)
+        if not full.startswith(st.abs_text):
+            # Decode not prefix-stable at this token boundary (byte-level
+            # merges can transiently rewrite the tail): hold back — the
+            # already-delivered text must never be retracted.
+            return
+        st.q.put_nowait(StreamDelta(
+            offset=st.sent_tokens, count=n - st.sent_tokens,
+            text=full[len(st.abs_text):], final=False,
+        ))
+        st.sent_tokens = n
+        st.abs_text = full
+
+    def _push_final(self, st: _StreamState,
+                    toks: Optional[List[int]], text: str) -> None:
+        n = len(toks) if toks is not None else max(st.sent_tokens, st.skip)
+        if st.abs_text is None:
+            eff = min(st.skip, n)
+            st.sent_tokens = eff
+            st.abs_text = (self.engine.decode_tokens(toks[:eff])
+                           if (toks and eff) else "")
+        # Best-effort slice when the final decode diverged from a held-
+        # back partial (the digest check downstream catches corruption).
+        st.q.put_nowait(StreamDelta(
+            offset=st.sent_tokens, count=max(0, n - st.sent_tokens),
+            text=text[len(st.abs_text):], final=True, full_text=text,
+        ))
 
     def _reap_observability(self) -> None:
         """Between steps: drain the engine's measured queue waits (closing

@@ -1208,6 +1208,17 @@ class PagedEngine:
         # Per-request time-to-first-token (submit() -> first token on host),
         # keyed by rid; the serving queue pops these into its histogram.
         self.ttfts: Dict[int, float] = {}
+        # Streaming (incremental token-yield) side channel: rids the
+        # serving queue watches for token-level progress. Final token
+        # lists are recorded at reap ONLY for watched rids (so bench
+        # harnesses that never stream accumulate nothing) and drained by
+        # pop_final_tokens().
+        self._stream_watch: set = set()
+        self._final_tokens: Dict[int, List[int]] = {}
+        # Multi-turn tutoring sessions: rid -> (session_id, pin ttl,
+        # prompt token snapshot). Filled by mark_session(); consumed at
+        # finish-reap by _publish_session().
+        self._session_reqs: Dict[int, Tuple[str, float, List[int]]] = {}
         # Speculation observability, accumulated at reap time from the
         # device counts plane and drained by pop_spec_stats(): windows run
         # for live slots and tokens they emitted (emitted/windows is the
@@ -1433,6 +1444,24 @@ class PagedEngine:
         self._pending.append(req)
         return req.rid
 
+    def mark_session(self, rid: int, session_id: str,
+                     ttl_s: float) -> bool:
+        """Tag a just-submitted request as a tutoring-session turn: at
+        finish its FULL transcript (prompt + generated tokens, eos
+        excluded) is published into the radix tree and session-pinned
+        with `ttl_s`, so the next turn — whose prompt splices this
+        transcript as its head — admits with a shared-prefix hit. Must
+        be called while the request is still pending (its `tokens` field
+        still holds the prompt). No-op without a prefix cache."""
+        if self.prefix_cache is None:
+            return False
+        for req in self._pending:
+            if req.rid == rid:
+                self._session_reqs[rid] = (session_id, float(ttl_s),
+                                           list(req.tokens))
+                return True
+        return False
+
     @property
     def backlog(self) -> int:
         """Requests submitted but not yet admitted to a decode slot (their
@@ -1447,6 +1476,8 @@ class PagedEngine:
         for i, req in enumerate(self._pending):
             if req.rid == rid:
                 del self._pending[i]
+                self._session_reqs.pop(rid, None)
+                self._stream_watch.discard(rid)
                 return True
         return False
 
@@ -1660,6 +1691,46 @@ class PagedEngine:
         out, self.ttfts = self.ttfts, {}
         return out
 
+    def stream_watch(self, rid: int) -> None:
+        """Mark `rid` as streamed: its final token list is retained at
+        reap for pop_final_tokens(). Idempotent."""
+        self._stream_watch.add(rid)
+
+    def stream_unwatch(self, rid: int) -> None:
+        self._stream_watch.discard(rid)
+        self._final_tokens.pop(rid, None)
+
+    def stream_snapshot(self, rids) -> Dict[int, List[int]]:
+        """Incremental token-yield channel: for each requested rid that is
+        live in a slot post-flip, a COPY of its generated-so-far token
+        list with eos filtered — the same token view decode() renders at
+        finish, so a streamed prefix is always a prefix of the final
+        transcript. Called by the serving queue between steps (never
+        concurrent with step())."""
+        want = set(rids)
+        out: Dict[int, List[int]] = {}
+        if not want:
+            return out
+        eos = self.tokenizer.eos_id
+        for req in self._slot_req:
+            if req is None or req.finished or not req.live:
+                continue
+            if req.rid in want:
+                out[req.rid] = [t for t in req.tokens if t != eos]
+        return out
+
+    def decode_tokens(self, tokens) -> str:
+        """Decode a generated-token prefix (stream offsets count these
+        tokens; resume-at-offset skips len(decode(tokens[:offset]))
+        chars)."""
+        return self.tokenizer.decode(list(tokens))
+
+    def pop_final_tokens(self) -> Dict[int, List[int]]:
+        """Drain the final (eos-filtered) token lists of watched streamed
+        requests that finished since the last call."""
+        out, self._final_tokens = self._final_tokens, {}
+        return out
+
     def pop_spec_stats(self) -> Optional[Tuple[int, int]]:
         """Drain (windows_run, tokens_emitted) accumulated at reap since the
         last call; None when speculation is off. emitted/windows is the mean
@@ -1688,6 +1759,9 @@ class PagedEngine:
         self._pending = []
         self._inflight = []
         self.ttfts = {}
+        self._stream_watch = set()
+        self._final_tokens = {}
+        self._session_reqs = {}
         self._prog_times = []
         self._queue_waits = {}
         self._staged_prompts = {}
@@ -2023,6 +2097,68 @@ class PagedEngine:
             self._dispatches += added - 1
             self._time_prog("export_block", t0, t0u)
         self._prefix_evictions += pc.evict_to_budget()
+
+    def _publish_session(self, req: _Request, slot: int) -> None:
+        """Finish-reap publish for a session turn: the slot's pages hold
+        KV for the prompt AND every generated token that was fed back
+        (all but the last sampled one), at absolute positions — so the
+        same block export that publishes prompts publishes the whole
+        turn transcript. The path is then session-pinned with the turn's
+        TTL so the follow-up question admits against it (its prompt
+        splices this transcript as its head). Same insert-then-evict
+        policy as the prompt publishes."""
+        entry = self._session_reqs.pop(req.rid, None)
+        pc = self.prefix_cache
+        if entry is None or pc is None:
+            return
+        session_id, ttl_s, prompt_toks = entry
+        eos = self.tokenizer.eos_id
+        gen: List[int] = []
+        for t in req.tokens:
+            if t == eos:
+                break
+            gen.append(t)
+        full = prompt_toks + gen
+        # KV exists only for FED positions: the last sampled token (and
+        # any eos) never re-entered the model, so its page is unwritten.
+        safe = min(len(full), req.prompt_len + len(req.tokens) - 1)
+        blk_t = pc.block_tokens
+        n = (safe // blk_t) * blk_t
+        if n <= 0:
+            return
+        t0, t0u = time.monotonic(), time.time()
+        self.state = self._canon_state(self.state)
+        slot_ix = jnp.asarray(slot, jnp.int32)
+
+        def make_block(i: int) -> KVBlock:
+            with self.mesh:
+                return self._canon_block(self._export_block(
+                    self.state.cache, jnp.asarray(i * blk_t, jnp.int32),
+                    slot_ix,
+                ))
+
+        added = pc.insert(full[:n], make_block)
+        if added:
+            self._dispatches += added - 1
+            self._time_prog("export_block", t0, t0u)
+        pc.pin_session(session_id, full[:n], ttl_s)
+        self._prefix_evictions += pc.evict_to_budget()
+
+    def release_session(self, session_id: str) -> bool:
+        """Explicitly drop a session's transcript pin (session closed)."""
+        if self.prefix_cache is None:
+            return False
+        return self.prefix_cache.release_session(session_id)
+
+    def session_pin_stats(self) -> Optional[Tuple[int, int]]:
+        """(live pinned sessions, blocks their paths hold resident) for
+        the session gauges; None without a prefix cache. Expires lapsed
+        pins as a side effect so the gauge never counts dead sessions."""
+        pc = self.prefix_cache
+        if pc is None:
+            return None
+        pc.expire_sessions()
+        return pc.session_count, pc.session_pinned_blocks()
 
     def _live(self) -> bool:
         return any(
@@ -2378,10 +2514,21 @@ class PagedEngine:
                     # The slot no longer reads shared blocks: unpin its
                     # matched path so eviction may reclaim it.
                     self.prefix_cache.release(pin)
+                if (req.rid in self._session_reqs
+                        and self._slot_req[slot] is req):
+                    # Session turn: publish + pin the full transcript
+                    # while the slot's pages still hold its KV.
+                    self._publish_session(req, slot)
+                self._session_reqs.pop(req.rid, None)
                 self.total_generated_tokens += len(req.tokens)
                 text = self.tokenizer.decode(
                     [t for t in req.tokens if t != eos]
                 )
+                if req.rid in self._stream_watch:
+                    self._final_tokens[req.rid] = [
+                        t for t in req.tokens if t != eos
+                    ]
+                    self._stream_watch.discard(req.rid)
                 done.append((req.rid, text))
                 if self._slot_req[slot] is req:
                     self._slot_req[slot] = None

@@ -49,6 +49,7 @@ from one runner coroutine, so there is no lock here by design.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -178,6 +179,14 @@ class PrefixCache:
         self._clock = 0
         self.blocks_used = 0
         self.evicted_blocks = 0   # cumulative, pop'd by the engine stats
+        # Multi-turn session pins: session_id -> (deepest pinned node,
+        # monotonic expiry). A session pin is SOFT — it protects a
+        # transcript path from LRU eviction until its TTL lapses or the
+        # session releases it, but under budget pressure with nothing
+        # unpinned left it is force-released in soonest-expiry order
+        # (the eviction-under-live-session-pin policy). Request refcount
+        # pins (`refs`, live slots) remain hard: never evicted.
+        self._session_pins: Dict[str, Tuple[_Node, float]] = {}
 
     # ------------------------------------------------------------- lookup
 
@@ -241,6 +250,67 @@ class PrefixCache:
         if match.nodes:
             match.nodes[-1].refs = max(0, match.nodes[-1].refs - 1)
 
+    # ----------------------------------------------------- session pins
+
+    def pin_session(self, session_id: str, tokens: Sequence[int],
+                    ttl_s: float, now: Optional[float] = None) -> int:
+        """Pin the cached path covering `tokens` for a tutoring session:
+        turn N's published transcript stays resident so turn N+1 splices
+        it as a shared prefix. Re-pinning the same session moves its pin
+        to the new (longer) transcript path and refreshes the TTL.
+        Returns the number of blocks the pinned path covers (0 = nothing
+        cached to pin)."""
+        now = time.monotonic() if now is None else now
+        keys = self._block_keys(tokens)
+        nodes, _used, matched = self._walk(keys)
+        if not nodes or matched == 0:
+            self._session_pins.pop(session_id, None)
+            return 0
+        self._session_pins[session_id] = (nodes[-1], now + ttl_s)
+        self._clock += 1
+        for node in nodes:
+            node.last_used = self._clock
+        return matched
+
+    def release_session(self, session_id: str) -> bool:
+        """Explicit release (session closed): the path becomes ordinary
+        LRU-evictable content immediately."""
+        return self._session_pins.pop(session_id, None) is not None
+
+    def expire_sessions(self, now: Optional[float] = None) -> int:
+        """Release pins whose TTL lapsed. Returns sessions released."""
+        now = time.monotonic() if now is None else now
+        dead = [sid for sid, (_, exp) in self._session_pins.items()
+                if exp <= now]
+        for sid in dead:
+            del self._session_pins[sid]
+        return len(dead)
+
+    def _session_nodes(self) -> Dict[int, float]:
+        """id(node) -> soonest expiry among the sessions pinning it."""
+        out: Dict[int, float] = {}
+        for node, exp in self._session_pins.values():
+            key = id(node)
+            out[key] = min(out.get(key, exp), exp)
+        return out
+
+    @property
+    def session_count(self) -> int:
+        return len(self._session_pins)
+
+    def session_pinned_blocks(self) -> int:
+        """Blocks held resident by session pins: the union of root->pin
+        paths (the `session_pinned_blocks` gauge)."""
+        seen: Dict[int, int] = {}
+        for node, _exp in self._session_pins.values():
+            cur: Optional[_Node] = node
+            while cur is not None and cur.parent is not None:
+                if id(cur) in seen:
+                    break
+                seen[id(cur)] = len(cur.blocks)
+                cur = cur.parent
+        return sum(seen.values())
+
     # ------------------------------------------------------------ insert
 
     def _split(self, node: _Node, j: int) -> _Node:
@@ -299,16 +369,42 @@ class PrefixCache:
                 out.append(n)
         return out
 
-    def evict_to_budget(self) -> int:
+    def evict_to_budget(self, now: Optional[float] = None) -> int:
         """Evict least-recently-used unpinned leaf nodes until
-        `blocks_used <= max_blocks` or nothing evictable remains (every
-        leaf pinned by a live slot: the budget transiently overruns
-        rather than freeing referenced blocks). Returns blocks freed."""
+        `blocks_used <= max_blocks` or nothing evictable remains.
+
+        Session-pin policy (ordered, each tier exhausted before the
+        next):
+
+        1. TTL-expired session pins are released first — an expired
+           session's transcript is ordinary LRU-evictable content.
+        2. Leaves with zero refs and no live session pin evict in LRU
+           order (the pre-session behavior).
+        3. Still over budget: live session pins are force-released in
+           soonest-expiry order (the session closest to lapsing loses
+           its residency guarantee), freeing their leaves for tier 2.
+        4. Leaves pinned by a live REQUEST (refs > 0) are never evicted:
+           the budget transiently overruns instead — a slot is actively
+           reading those blocks.
+
+        Returns blocks freed."""
+        now = time.monotonic() if now is None else now
+        self.expire_sessions(now)
         freed = 0
         while self.blocks_used > self.max_blocks:
-            victims = [n for n in self._leaves() if n.refs == 0]
+            protected = self._session_nodes()
+            victims = [n for n in self._leaves()
+                       if n.refs == 0 and id(n) not in protected]
             if not victims:
-                break
+                # Everything evictable is session-pinned: force-release
+                # the pin nearest its TTL and retry; if only request
+                # pins remain, overrun.
+                if not self._session_pins:
+                    break
+                sid = min(self._session_pins,
+                          key=lambda s: self._session_pins[s][1])
+                del self._session_pins[sid]
+                continue
             victim = min(victims, key=lambda n: n.last_used)
             assert victim.parent is not None
             del victim.parent.children[victim.edge[0]]
@@ -322,9 +418,11 @@ class PrefixCache:
     def clear(self) -> None:
         """Drop every cached block (warmup hygiene: ghost prompts must
         not seed the live tree). Pins are owned by the engine, which
-        clears its own pin table alongside."""
+        clears its own pin table alongside; session pins die with the
+        tree they pointed into."""
         self._root = _Node(edge=[], blocks=[], parent=None)
         self.blocks_used = 0
+        self._session_pins = {}
 
     @property
     def node_count(self) -> int:

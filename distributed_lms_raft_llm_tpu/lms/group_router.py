@@ -472,7 +472,11 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
         response = await self._forward(
             self._addresses[leader], gid, name, request, context, extra_md
         )
-        self.hints.update(gid, leader)
+        # Hints are an advisory last-wins cache: a concurrent request
+        # confirming a different leader may land first, and the next
+        # miss self-corrects — staleness costs one extra hop, never
+        # correctness.
+        self.hints.update(gid, leader)  # lint: disable=atomicity-across-await
         return response
 
     def _stub(self, address: str) -> Any:
@@ -562,6 +566,106 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
                 grpc.StatusCode.UNAVAILABLE,
                 f"forward to group {gid} leader failed ({code}); retry",
             )
+
+    async def _execute_stream(
+        self,
+        gid: int,
+        request: Any,
+        context: Any,
+        *,
+        extra_md: Optional[List[Tuple[str, str]]] = None,
+        subject: Optional[str] = None,
+    ) -> Any:
+        """Streamed `StreamLLMAnswer` on group `gid`'s leader: local
+        async-generator dispatch when this node leads the group, else
+        one forwarded streaming hop to the leader's router.
+
+        Freeze-guard parity with the unary GetLLMAnswer: the pre-check
+        runs before the first chunk (the degraded fallback's AskQuery
+        propose happens only pre-first-byte, so a frozen user is turned
+        away before any write could be no-opped). There is no post-write
+        re-check — once chunks have streamed, the answer was delivered
+        and retrying would double-deliver; a freeze that lands mid-answer
+        only affects the NEXT turn's routing."""
+        node = self._nodes[gid]
+        if node.node.is_leader:
+            self._guard_subject(gid, subject)
+            inner_md = (extra_md or []) + self._relayed_auth_md(
+                context, extra_md
+            )
+            handler = self._inner[gid].StreamLLMAnswer
+            async for chunk in handler(
+                request, _InnerContext(context, inner_md)
+            ):
+                yield chunk
+            self.hints.update(gid, self._self_id)
+            return
+        if self._hops(context) >= MAX_FORWARD_HOPS:
+            raise RouteError(
+                grpc.StatusCode.UNAVAILABLE,
+                f"forward hop limit reached for group {gid}",
+            )
+        leader = node.node.leader_id
+        if leader is None or leader == self._self_id:
+            leader = self.hints.get(gid)
+        if (leader is None or leader == self._self_id
+                or leader not in self._addresses):
+            raise RouteError(
+                grpc.StatusCode.UNAVAILABLE,
+                f"group {gid} has no known leader",
+            )
+        deadline = Deadline.from_grpc_context(context)
+        timeout = (
+            deadline.timeout(cap=self._forward_timeout_s)
+            if deadline is not None
+            else self._forward_timeout_s
+        )
+        md: List[Tuple[str, str]] = [
+            (GROUP_METADATA_KEY, str(gid)),
+            (HOPS_METADATA_KEY, str(self._hops(context) + 1)),
+        ]
+        rid = request_id_from_grpc_context(context)
+        if rid:
+            md.append((REQUEST_ID_METADATA_KEY, rid))
+        user_hint = _metadata_get(context, USER_METADATA_KEY)
+        if user_hint:
+            md.append((USER_METADATA_KEY, user_hint))
+        if deadline is not None:
+            md.extend(deadline.to_metadata())
+        if extra_md:
+            md.extend(extra_md)
+        md.extend(self._relayed_auth_md(context, md))
+        signable = [(k, v) for k, v in md if k.startswith("x-lms-")]
+        md.append(
+            (ROUTER_SIG_METADATA_KEY,
+             sign_router_metadata(self._router_secret, signable))
+        )
+        stub = self._stub(self._addresses[leader])
+        self.metrics.inc(series.ROUTER_GROUP_FORWARDS)
+        delivered = False
+        try:
+            async for chunk in stub.StreamLLMAnswer(
+                request, timeout=timeout, metadata=trace_metadata(md)
+            ):
+                delivered = True
+                yield chunk
+        except grpc.RpcError as exc:
+            self.hints.evict(gid)
+            code = exc.code() if hasattr(exc, "code") else "?"
+            # Mid-stream loss after chunks already went out cannot be
+            # transparently retried here (the router does not know the
+            # client's delivered offset) — surface UNAVAILABLE so the
+            # CLIENT resumes at its own offset; pre-first-chunk the
+            # failure is an ordinary retryable routing error.
+            raise RouteError(
+                grpc.StatusCode.UNAVAILABLE,
+                f"stream forward to group {gid} leader "
+                f"{'lost mid-answer' if delivered else 'failed'} "
+                f"({code}); "
+                + ("resume at your delivered offset"
+                   if delivered else "retry"),
+            )
+        self.hints.update(gid, leader)
 
     # ------------------------------------------------------ dispatch modes
 
@@ -747,6 +851,30 @@ class RoutedLMSServicer(rpc.LMSServicer):  # type: ignore[misc]
 
     async def GetLLMAnswer(self, request: Any, context: Any) -> Any:
         return await self._dispatch("token", "GetLLMAnswer", request, context)
+
+    async def StreamLLMAnswer(self, request: Any, context: Any) -> Any:
+        """Streamed twin of GetLLMAnswer: same token-routing and
+        write/freeze guard (the degraded fallback proposes an AskQuery),
+        but the response is an async chunk generator, so it dispatches
+        through `_execute_stream` instead of `_dispatch`. Session
+        affinity is unaffected by group routing — the session rides the
+        request to whichever tutoring node the TARGET group's pool pins
+        it to, and group targeting is stable for a user between map
+        flips."""
+        try:
+            targeted = self._targeted_group(context)
+            subject = self._resolve_user(request.token, context)
+            gid = (targeted if targeted is not None
+                   else self._home_group(subject))
+            extra: Optional[List[Tuple[str, str]]] = None
+            if targeted is None and subject is not None:
+                extra = [(USER_METADATA_KEY, subject)]
+            async for chunk in self._execute_stream(
+                gid, request, context, extra_md=extra, subject=subject
+            ):
+                yield chunk
+        except RouteError as exc:
+            await context.abort(exc.code, exc.details)
 
     async def GetUnansweredQueries(self, request: Any, context: Any) -> Any:
         return await self._dispatch("fanout", "GetUnansweredQueries", request, context)

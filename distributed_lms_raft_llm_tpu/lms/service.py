@@ -760,6 +760,141 @@ class LMSServicer(rpc.LMSServicer):
                 )
         return answer
 
+    @staticmethod
+    def _final_chunk(response) -> "lms_pb2.StreamChunk":
+        """Adapt a unary QueryResponse (gate refusal, degraded fallback,
+        config errors) into a single final StreamChunk. `count` stays 0 —
+        these texts are not token streams and carry no digest; the client
+        treats them exactly like the unary answer they are."""
+        return lms_pb2.StreamChunk(
+            success=response.success, text=response.response, final=True,
+        )
+
+    @traced_grpc_handler("lms.StreamLLMAnswer")
+    async def StreamLLMAnswer(self, request, context):
+        """Streamed sibling of GetLLMAnswer: same fence, auth, gate, and
+        budget policy; the answer arrives as resumable chunks relayed
+        from the tutoring fleet (lms/tutoring_pool.forward_stream owns
+        hedging, stall detection, and resume-at-offset failover).
+        Degraded fallbacks can only happen BEFORE the first delivered
+        byte — mid-stream exhaustion aborts instead, and the client
+        resumes with `resume_offset` = its delivered token count."""
+        await self._read_fence(context)
+        self.metrics.inc("llm_requests")
+        client_rid = request_id_from_grpc_context(context)
+        auth = self._auth(request.token)
+        if auth is None:
+            yield lms_pb2.StreamChunk(success=False, final=True,
+                                      text="Invalid session")
+            return
+        username, role = auth
+        if role != "student":
+            yield lms_pb2.StreamChunk(
+                success=False, final=True,
+                text="Only students can query the LLM tutor",
+            )
+            return
+        assignments = self.state.assignments_of(username)
+        if not assignments:
+            yield lms_pb2.StreamChunk(
+                success=False, final=True,
+                text="Upload an assignment before asking the LLM tutor.",
+            )
+            return
+        with self.metrics.time("llm_ttft"):
+            if self.gate is not None:
+                assignment_text = assignments[0].get("text") or ""
+                loop = asyncio.get_running_loop()
+                with get_tracer().span("gate.check") as gsp:
+                    passed, sim = await loop.run_in_executor(
+                        None, self.gate.check, request.query,
+                        assignment_text
+                    )
+                    gsp.set_attr("passed", bool(passed))
+                self.metrics.inc("gate_pass" if passed else "gate_reject")
+                if not passed:
+                    yield lms_pb2.StreamChunk(
+                        success=True, final=True,
+                        text=(
+                            "Your query does not appear related to your "
+                            f"assignment (similarity {sim:.2f}); please "
+                            "ask your instructor instead."
+                        ),
+                    )
+                    return
+            if not self.pool.configured:
+                yield lms_pb2.StreamChunk(
+                    success=False, final=True,
+                    text="Tutoring service not configured.",
+                )
+                return
+            deadline = Deadline.from_grpc_context(context)
+            budget = (
+                deadline.timeout(cap=self._tutoring_timeout_s)
+                if deadline is not None
+                else self._tutoring_timeout_s
+            )
+            if deadline is not None and budget <= self._deadline_floor_s:
+                self.metrics.inc("tutoring_budget_exhausted")
+                cur = get_tracer().current()
+                if cur is not None:
+                    cur.flag(FLAG_DEADLINE)
+                yield self._final_chunk(await self._degraded_answer(
+                    username, request.query, "deadline budget exhausted",
+                    request_id=client_rid,
+                ))
+                return
+            fwd_token = (
+                sign_query(self._tutoring_auth_key, request.query)
+                if self._tutoring_auth_key
+                else request.token
+            )
+            sent_any = False
+            try:
+                async for chunk in self.pool.forward_stream(
+                    request.query, fwd_token, deadline=deadline,
+                    session_id=request.session_id,
+                    resume_offset=request.resume_offset,
+                ):
+                    self.metrics.inc("stream_chunks")
+                    yield chunk
+                    sent_any = True
+            except TutoringUnavailable as e:
+                if sent_any:
+                    # Delivered text can't be retracted into a degraded
+                    # answer: abort so the client resumes at its offset
+                    # (possibly against a re-elected leader).
+                    log.warning("stream lost mid-answer: %s", e)
+                    await context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"stream lost mid-answer ({e}); resume at your "
+                        "delivered offset",
+                    )
+                if e.kind == "breaker":
+                    self.metrics.inc("tutoring_breaker_rejections")
+                    yield self._final_chunk(await self._degraded_answer(
+                        username, request.query, "circuit open",
+                        request_id=client_rid,
+                    ))
+                    return
+                if e.kind == "budget":
+                    self.metrics.inc("tutoring_budget_exhausted")
+                    cur = get_tracer().current()
+                    if cur is not None:
+                        cur.flag(FLAG_DEADLINE)
+                    yield self._final_chunk(await self._degraded_answer(
+                        username, request.query,
+                        "deadline budget exhausted",
+                        request_id=client_rid,
+                    ))
+                    return
+                log.warning("tutoring fleet unavailable: %s", e)
+                yield self._final_chunk(await self._degraded_answer(
+                    username, request.query, str(e),
+                    request_id=client_rid,
+                ))
+                return
+
     @traced_grpc_handler("lms.WhoIsLeader")
     async def WhoIsLeader(self, request, context):
         # Implemented on LMS as the contract declares (reference D6 left it

@@ -88,6 +88,14 @@ class TutoringUnavailable(Exception):
         self.kind = kind
 
 
+class StreamProtocolError(ConnectionError):
+    """A streamed chunk violated the resumable-stream contract (offset
+    gap, or a partial overlap that cannot be trimmed at a token
+    boundary). Subclasses ConnectionError so the router's node-failure
+    handling (`_NODE_ERRORS` includes OSError) treats the sender as
+    failed and resumes on the next candidate."""
+
+
 def affinity_key(query: str) -> str:
     """The routing key: the normalized head of the prompt. Same-course
     asks share their course-context prefix (sim/workload.course_context
@@ -95,6 +103,15 @@ def affinity_key(query: str) -> str:
     land on the node already holding those radix blocks; bare queries
     key on themselves, so repeated questions still co-locate."""
     return " ".join(query.split()).lower()[:64]
+
+
+def session_affinity_key(session_id: str) -> str:
+    """The routing key of a multi-turn tutoring session: every turn of
+    one session keys identically — and differently from any query key
+    (the `sess:` namespace) — so the rendezvous ring keeps the session
+    sticky to the node holding its transcript and its pinned radix KV
+    blocks, regardless of how each turn's query text hashes."""
+    return "sess:" + " ".join(session_id.split())[:64]
 
 
 async def _http_get_raw(address: str, path: str,
@@ -310,6 +327,7 @@ class TutoringPool:
         warmup_s: float = 5.0,
         warmup_weight: float = 0.25,
         health_poll_s: float = 1.0,
+        stream_stall_s: float = 2.0,
         clock=time.monotonic,
     ):
         self.metrics = metrics or Metrics()
@@ -321,6 +339,12 @@ class TutoringPool:
         self.warmup_s = warmup_s
         self.warmup_weight = warmup_weight
         self.health_poll_s = health_poll_s
+        # Per-chunk stall watch on streamed forwards: an open-but-silent
+        # stream (node wedged, network black hole past the TCP handshake)
+        # is declared failed after this much inter-chunk silence — the
+        # breaker records it and the stream resumes at the delivered
+        # offset on the next candidate. 0 disables the watch.
+        self.stream_stall_s = stream_stall_s
         # A queue-depth reading older than this is treated as drained:
         # fleets without health polling only learn depth from response
         # trailers, and a node spilled around receives no trailers — a
@@ -517,11 +541,16 @@ class TutoringPool:
                 return order[1:] + order[:1], "spill:budget", affinity
         return order, "affinity", affinity
 
-    def route_snapshot(self, query: str) -> Dict[str, Any]:
+    def route_snapshot(self, query: str,
+                       session_id: str = "") -> Dict[str, Any]:
         """Read-only routing answer for `GET /admin/tutoring/route?q=`:
         which node would serve this query, and the spill order behind
-        it."""
-        key = affinity_key(query)
+        it. A session id answers for the SESSION's sticky key instead
+        (`&session=<sid>` — the key every turn of that session routes
+        by), so the chaos drills can fault exactly the node holding a
+        live session's transcript."""
+        key = (session_affinity_key(session_id) if session_id
+               else affinity_key(query))
         now = self._clock()
         return {
             "key": key,
@@ -822,6 +851,311 @@ class TutoringPool:
                 t.cancel()
             if live:
                 await asyncio.gather(*live, return_exceptions=True)
+
+    # ------------------------------------------------------ streaming forward
+
+    async def forward_stream(
+        self, query: str, token: str,
+        deadline: Optional[Deadline] = None,
+        *, session_id: str = "", resume_offset: int = 0,
+    ):
+        """Route one streamed tutoring query; an async generator of
+        `StreamChunk`s upholding the resumable-stream contract end to
+        end:
+
+        - offsets are monotone and gap-free from `resume_offset` through
+          the final chunk, across ANY number of mid-stream failovers;
+        - hedging happens only BEFORE the first chunk (a raced fork can
+          be cancelled unread); after the first delivered byte a broken
+          stream is *resumed at the delivered offset* on the next
+          candidate — never forked, never restarted, so no token is ever
+          delivered twice or dropped;
+        - pure-duplicate chunks from an over-eager resume are dropped;
+          an offset gap or a mid-chunk overlap is a protocol violation
+          that fails the sending node (`StreamProtocolError`);
+        - a session id re-keys the ring (`session_affinity_key`) so every
+          turn of a session lands on the node holding its transcript and
+          pinned prefix blocks.
+
+        Raises TutoringUnavailable when no node can continue; the caller
+        checks whether any byte was already delivered to choose between
+        the degraded fallback and a hard abort."""
+        if not self._nodes:
+            raise TutoringUnavailable("no tutoring nodes configured",
+                                      kind="none")
+        key = (session_affinity_key(session_id) if session_id
+               else affinity_key(query))
+        order, route_reason, affinity = self.plan_route(key, deadline)
+        if not order:
+            raise TutoringUnavailable(
+                "every tutoring node is draining or ejected",
+                kind="ejected",
+            )
+        if any(not n.routable() for n in self._nodes):
+            full = self.rendezvous_order(key, routable_only=False)
+            owner = full[0] if full else affinity
+        else:
+            owner = affinity
+        with get_tracer().span("router.pick", key=key[:48]) as sp:
+            sp.set_attr("stream", True)
+            sp.set_attr("reason", route_reason)
+            sp.set_attr("candidates", len(order))
+        delivered = max(0, int(resume_offset))
+        tried: set = set()
+        first_byte = False
+        while True:
+            if first_byte:
+                # Continuing a stream this generator already delivered
+                # bytes of: failover = resume-at-offset, by definition.
+                self.metrics.inc(metric.STREAM_RESUMES)
+            node, gen, chunk = await self._next_stream(
+                order, tried, query, token, deadline, session_id,
+                delivered,
+                # Hedging forks generation, safe only while nothing has
+                # been delivered ANYWHERE in the logical stream — a
+                # client-driven resume (resume_offset > 0) is past that
+                # point even though this RPC has sent nothing yet.
+                allow_hedge=not first_byte and delivered == 0,
+            )
+            if node is not owner:
+                self.metrics.inc(metric.TUTORING_SPILLS)
+            try:
+                while True:
+                    if chunk.success and chunk.count > 0:
+                        end = chunk.offset + chunk.count
+                        if end <= delivered:
+                            pass  # pure duplicate (over-eager resume): drop
+                        elif chunk.offset != delivered:
+                            raise StreamProtocolError(
+                                f"stream chunk offset {chunk.offset} != "
+                                f"delivered {delivered} from {node.address}"
+                            )
+                        else:
+                            delivered = end
+                            first_byte = True
+                            yield chunk
+                    else:
+                        # Failure chunks and empty finals pass through
+                        # unvalidated (no token payload to account).
+                        yield chunk
+                    if chunk.final:
+                        node.served += 1
+                        return
+                    chunk = await gen.__anext__()
+            except StopAsyncIteration as e:
+                self._note_failure(node, StreamProtocolError(
+                    f"stream from {node.address} ended without a final "
+                    "chunk"
+                ))
+                last = e
+            except TutoringUnavailable:
+                raise
+            except _NODE_ERRORS as e:
+                self._note_failure(node, e)
+                last = e
+            finally:
+                # Must-complete teardown: a cancelled forward must not
+                # leave the node-side RPC open computing tokens nobody
+                # reads.
+                await asyncio.shield(self._close_stream(gen, None))
+            log.warning("stream from %s broke at offset %d (%s); "
+                        "resuming on the next candidate", node.address,
+                        delivered, type(last).__name__)
+
+    async def _next_stream(
+        self, order: List[TutoringNode], tried: set, query: str,
+        token: str, deadline: Optional[Deadline], session_id: str,
+        offset: int, allow_hedge: bool,
+    ) -> Tuple[TutoringNode, Any, Any]:
+        """Open a stream on the best untried candidate whose breaker
+        admits it; returns (node, chunk generator, first chunk). The
+        hedge window applies only here — to the FIRST chunk: when the
+        primary sits silent past `hedge_after_s`, a second stream races
+        it and the loser is cancelled before anything was delivered.
+        Nodes are marked `tried` when they fail or win (a cancelled
+        hedge loser stays eligible as a later resume target)."""
+        last_error: Optional[BaseException] = None
+        budget_exhausted = False
+        attempted = False
+
+        def next_candidate() -> Optional[TutoringNode]:
+            return next(
+                (n for n in order if n not in tried and n.breaker.allow()),
+                None,
+            )
+
+        while True:
+            node = next_candidate()
+            if node is None:
+                break
+            attempted = True
+            node.routes += 1
+            gen = self._attempt_stream(node, query, token, deadline,
+                                       session_id, offset)
+            first = asyncio.ensure_future(gen.__anext__())
+            racers: Dict[asyncio.Future, Tuple[TutoringNode, Any]] = {
+                first: (node, gen)
+            }
+            if allow_hedge and self._can_hedge(deadline):
+                done, _ = await asyncio.wait({first},
+                                             timeout=self.hedge_after_s)
+                if not done:
+                    hnode = next_candidate()
+                    if hnode is not None and hnode is not node:
+                        self.metrics.inc(metric.TUTORING_HEDGES)
+                        hnode.routes += 1
+                        hgen = self._attempt_stream(
+                            hnode, query, token, deadline, session_id,
+                            offset,
+                        )
+                        racers[asyncio.ensure_future(hgen.__anext__())] = (
+                            hnode, hgen
+                        )
+            pending = set(racers)
+            winner: Optional[asyncio.Future] = None
+            while pending and winner is None:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                # Prefer the primary when both land in one wake-up, so
+                # hedge wins mean "the hedge was genuinely faster".
+                for task in sorted(done, key=lambda t: t is not first):
+                    t_node, _t_gen = racers[task]
+                    if task.cancelled():
+                        continue
+                    exc = task.exception()
+                    if exc is None:
+                        winner = task
+                        break
+                    tried.add(t_node)
+                    if isinstance(exc, StopAsyncIteration):
+                        last_error = StreamProtocolError(
+                            f"stream from {t_node.address} closed before "
+                            "any chunk"
+                        )
+                        self._note_failure(t_node, last_error)
+                    elif isinstance(exc, TutoringUnavailable):
+                        budget_exhausted = (budget_exhausted
+                                            or exc.kind == "budget")
+                        last_error = exc
+                    elif isinstance(exc, _NODE_ERRORS):
+                        last_error = exc
+                        self._note_failure(t_node, exc)
+                    else:
+                        for lt, (_ln, lg) in racers.items():
+                            if lt is not task:
+                                await self._close_stream(lg, lt)
+                        raise exc
+            if winner is not None:
+                wnode, wgen = racers[winner]
+                tried.add(wnode)
+                if winner is not first:
+                    self.metrics.inc(metric.TUTORING_HEDGE_WINS)
+                for task, (_n, g) in racers.items():
+                    if task is not winner:
+                        await self._close_stream(g, task)
+                # Already-done asyncio.Task: result() is immediate.
+                return wnode, wgen, winner.result()  # lint: disable=no-blocking-in-async
+            for task, (_n, g) in racers.items():
+                await self._close_stream(g, task)
+        if budget_exhausted and not isinstance(last_error, _NODE_ERRORS):
+            raise TutoringUnavailable("deadline budget exhausted",
+                                      kind="budget")
+        if not attempted:
+            raise TutoringUnavailable("circuit open", kind="breaker")
+        raise TutoringUnavailable(
+            f"tutoring stream failed ({self._describe(last_error)})",
+            kind="rpc",
+        )
+
+    @staticmethod
+    async def _close_stream(gen: Any,
+                            task: Optional[asyncio.Future]) -> None:
+        """Tear down one attempt's generator (and its in-flight first-
+        chunk task): a hedge loser or a broken stream must not keep its
+        RPC open computing tokens nobody reads."""
+        if task is not None and not task.done():
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+        try:
+            await gen.aclose()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+    async def _attempt_stream(
+        self, node: TutoringNode, query: str, token: str,
+        deadline: Optional[Deadline], session_id: str, resume_offset: int,
+    ):
+        """One node's streamed attempt: an async generator of raw
+        chunks. Inter-chunk silence past `stream_stall_s` raises
+        asyncio.TimeoutError (a `_NODE_ERRORS` member — the caller's
+        breaker bookkeeping treats the wedged-but-open stream exactly
+        like a dead node). The chaos `error` fault injects a mid-stream
+        loss AFTER the first chunk, exercising resume-at-offset."""
+        if deadline is not None and (
+            deadline.timeout(cap=self.timeout_s) <= self.deadline_floor_s
+        ):
+            raise TutoringUnavailable("deadline budget exhausted",
+                                      kind="budget")
+        plan = None
+        if self.faults is not None:
+            plan = await self.faults.apply_pre(node.fault_target())
+        t0 = time.monotonic()
+        md = deadline.to_metadata() if deadline is not None else None
+        req = lms_pb2.StreamRequest(
+            token=token, query=query, session_id=session_id,
+            resume_offset=resume_offset,
+        )
+        cancelled = False
+        sent = 0
+        with get_tracer().span("tutoring.stream", node=node.address,
+                               resume_offset=resume_offset) as sp:
+            call = node.stub().StreamLLMAnswer(
+                req,
+                timeout=self._attempt_timeout(deadline),
+                metadata=trace_metadata(md),
+            )
+            try:
+                while True:
+                    if self.stream_stall_s > 0:
+                        try:
+                            chunk = await asyncio.wait_for(
+                                call.read(), self.stream_stall_s
+                            )
+                        except asyncio.TimeoutError:
+                            self.metrics.inc(metric.STREAM_STALLS)
+                            sp.set_status("stalled")
+                            sp.set_attr("stalled_at_chunk", sent)
+                            raise
+                    else:
+                        chunk = await call.read()
+                    if chunk is grpc.aio.EOF:
+                        break
+                    yield chunk
+                    sent += 1
+                    if plan is not None and plan.error:
+                        raise FaultInjected(
+                            f"injected mid-stream loss <- "
+                            f"{node.fault_target()}"
+                        )
+                served = await self._read_trailer(call, node)
+                sp.set_attr("served_by", served)
+                sp.set_attr("chunks", sent)
+                node.note_latency(time.monotonic() - t0)
+            # See _attempt: the re-raise happens after the span block so
+            # it closes cleanly; `if cancelled: raise` below always
+            # fires, so cancellation is never actually swallowed.
+            # lint: disable-next=cancellation-safety
+            except asyncio.CancelledError:
+                # A hedge-race loser (or the handler going away): normal
+                # operation, not an error.
+                sp.set_status("cancelled")
+                sp.set_attr("cancelled", True)
+                cancelled = True
+            finally:
+                call.cancel()
+        if cancelled:
+            raise asyncio.CancelledError()
 
     @staticmethod
     def _describe(exc: Optional[BaseException]) -> str:

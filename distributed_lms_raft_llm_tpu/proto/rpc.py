@@ -32,8 +32,9 @@ def _load_services() -> Dict[str, Dict[str, Tuple[Any, Any, str]]]:
     """Derive {service: {method: (req_cls, resp_cls, arity)}} from the
     generated descriptor so stubs/servicers can never drift from lms.proto.
 
-    arity: "uu" = unary-unary, "su" = stream-unary (the only shapes the
-    contract uses; server-streaming would need a third branch below).
+    arity: "uu" = unary-unary, "su" = stream-unary, "us" = unary-stream
+    (server streaming, e.g. StreamLLMAnswer). Bidirectional streaming is not
+    part of the contract and asserts below.
     """
     sym_db = symbol_database.Default()
     services: Dict[str, Dict[str, Tuple[Any, Any, str]]] = {}
@@ -42,8 +43,13 @@ def _load_services() -> Dict[str, Dict[str, Tuple[Any, Any, str]]]:
         for method in service.methods:
             req = sym_db.GetSymbol(method.input_type.full_name)
             resp = sym_db.GetSymbol(method.output_type.full_name)
-            assert not method.server_streaming, method.full_name
-            arity = "su" if method.client_streaming else "uu"
+            assert not (method.client_streaming and method.server_streaming), method.full_name
+            if method.server_streaming:
+                arity = "us"
+            elif method.client_streaming:
+                arity = "su"
+            else:
+                arity = "uu"
             methods[method.name] = (req, resp, arity)
         services[service_name] = methods
     return services
@@ -58,6 +64,12 @@ def _make_stub_class(service: str, methods: Dict[str, Tuple[Any, Any, str]]):
             path = f"/{_PACKAGE}.{service}/{name}"
             if arity == "uu":
                 handle = channel.unary_unary(
+                    path,
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString,
+                )
+            elif arity == "us":  # server streaming
+                handle = channel.unary_stream(
                     path,
                     request_serializer=req.SerializeToString,
                     response_deserializer=resp.FromString,
@@ -93,11 +105,12 @@ def _make_adder(service: str, methods: Dict[str, Tuple[Any, Any, str]]):
     def adder(servicer, server: grpc.Server) -> None:
         handlers = {}
         for name, (req, resp, arity) in methods.items():
-            factory = (
-                grpc.unary_unary_rpc_method_handler
-                if arity == "uu"
-                else grpc.stream_unary_rpc_method_handler
-            )
+            if arity == "uu":
+                factory = grpc.unary_unary_rpc_method_handler
+            elif arity == "us":
+                factory = grpc.unary_stream_rpc_method_handler
+            else:
+                factory = grpc.stream_unary_rpc_method_handler
             handlers[name] = factory(
                 getattr(servicer, name),
                 request_deserializer=req.FromString,

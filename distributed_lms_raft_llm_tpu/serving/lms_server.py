@@ -284,10 +284,15 @@ def make_admin(lms_node: LMSNode, faults: FaultInjector,
             prefix = "/admin/tutoring/route"
             if path.startswith(prefix):
                 qs = urllib.parse.urlparse(path).query
-                q = urllib.parse.parse_qs(qs).get("q", [""])[0]
-                if not q:
-                    raise ValueError("route needs ?q=<query>")
-                return {"ok": True, **pool.route_snapshot(q)}
+                params = urllib.parse.parse_qs(qs)
+                q = params.get("q", [""])[0]
+                sid = params.get("session", [""])[0]
+                if not q and not sid:
+                    raise ValueError(
+                        "route needs ?q=<query> or ?session=<sid>"
+                    )
+                return {"ok": True,
+                        **pool.route_snapshot(q, session_id=sid)}
             raise KeyError(path)
         if path == "/admin/raft":
             # Read-only sharded-control-plane topology: routing map
@@ -410,6 +415,7 @@ async def serve_async(args) -> None:
             addresses=fleet_addresses,
             health_addresses=fleet_health,
             hedge_after_s=args.tutoring_hedge_after,
+            stream_stall_s=args.tutoring_stream_stall,
             queue_spill_depth=args.tutoring_queue_spill,
             warmup_s=args.tutoring_warmup,
             warmup_weight=args.tutoring_warmup_weight,
@@ -428,6 +434,7 @@ async def serve_async(args) -> None:
         timeout_s=args.tutoring_timeout,
         deadline_floor_s=args.deadline_floor,
         hedge_after_s=fleet_cfg.hedge_after_s,
+        stream_stall_s=fleet_cfg.stream_stall_s,
         queue_spill_depth=fleet_cfg.queue_spill_depth,
         warmup_s=fleet_cfg.warmup_s,
         warmup_weight=fleet_cfg.warmup_weight,
@@ -656,6 +663,14 @@ def main(argv=None) -> None:
                         "second-choice node after this many seconds of "
                         "silence (first answer wins, loser cancelled; "
                         "0 disables hedging)")
+    parser.add_argument("--tutoring-stream-stall", type=float,
+                        default=2.0,
+                        help="per-chunk stall watchdog for streamed "
+                        "tutoring forwards: if an OPEN stream goes this "
+                        "many seconds without yielding a chunk the node "
+                        "is treated as failed (breaker records it) and "
+                        "the stream resumes at the last delivered offset "
+                        "on the next candidate (0 disables)")
     parser.add_argument("--tutoring-queue-spill", type=int, default=8,
                         help="spill to the second-choice node when the "
                         "affinity node's serving queue is deeper than "
@@ -791,6 +806,7 @@ def main(argv=None) -> None:
             "tutoring_health": (",".join(fleet.health_addresses)
                                 if fleet.health_addresses else None),
             "tutoring_hedge_after": fleet.hedge_after_s,
+            "tutoring_stream_stall": fleet.stream_stall_s,
             "tutoring_queue_spill": fleet.queue_spill_depth,
             "tutoring_warmup": fleet.warmup_s,
             "tutoring_warmup_weight": fleet.warmup_weight,

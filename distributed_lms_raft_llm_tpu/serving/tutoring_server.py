@@ -20,9 +20,11 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import hashlib
 import json
 import logging
-from typing import Optional
+import time
+from typing import Dict, Optional, Tuple
 
 import grpc
 
@@ -59,11 +61,19 @@ PROMPT_TEMPLATE = (
     "and concisely.\nQuestion: {query}\nAnswer:"
 )
 
+# Follow-up turns of a tutoring session append to the running transcript
+# (turn N's prompt + answer) instead of re-framing from scratch, so the
+# session's token prefix is byte-stable across turns and the radix prefix
+# cache can splice turn N's KV blocks under turn N+1's prompt.
+FOLLOWUP_TEMPLATE = "\nQuestion: {query}\nAnswer:"
+
 
 class TutoringService(rpc.TutoringServicer):
     def __init__(self, queue: BatchingQueue, metrics: Metrics,
                  auth_key: Optional[str] = None,
-                 node_id: Optional[str] = None):
+                 node_id: Optional[str] = None,
+                 session_ttl_s: float = 600.0,
+                 session_max: int = 256):
         self.queue = queue
         self.metrics = metrics
         self.auth_key = auth_key
@@ -72,6 +82,17 @@ class TutoringService(rpc.TutoringServicer):
         # attribute answers to fleet members.
         self.node_id = node_id
         self.draining = False  # guarded-by: event-loop
+        # Multi-turn tutoring sessions ([sessions] in the TOML): this
+        # node's running transcripts, session_id -> (transcript text,
+        # expiry). The transcript is the byte-exact prompt+answer of every
+        # turn served HERE, so turn N+1's prompt extends it verbatim and
+        # the radix prefix cache splices turn N's KV blocks. Node-local by
+        # design — the affinity router keeps a session sticky to one node;
+        # a session that lands elsewhere (failover) restarts its
+        # transcript there and only loses cache warmth, never correctness.
+        self.session_ttl_s = float(session_ttl_s)
+        self.session_max = int(session_max)
+        self._sessions: Dict[str, Tuple[str, float]] = {}  # event-loop only
 
     def set_draining(self, draining: bool) -> None:
         """POST /admin/drain: stop admitting new queries while in-flight
@@ -84,6 +105,37 @@ class TutoringService(rpc.TutoringServicer):
         log.info("tutoring node %s %s", self.node_id or "(unnamed)",
                  "draining: admission stopped" if self.draining
                  else "drain ended: admitting again")
+
+    def _session_transcript(self, session_id: str) -> str:
+        """Live transcript for `session_id` ('' = fresh/expired session)."""
+        entry = self._sessions.get(session_id)
+        if entry is None:
+            return ""
+        text, expiry = entry
+        if time.monotonic() >= expiry:
+            self._drop_session(session_id)
+            return ""
+        return text
+
+    def _session_update(self, session_id: str, transcript: str) -> None:
+        """Record the turn's prompt+answer; refresh the TTL; enforce the
+        per-node cap (oldest-expiry sessions out first — their prefix
+        pins are released so the blocks fall back to plain LRU)."""
+        self._sessions[session_id] = (
+            transcript, time.monotonic() + self.session_ttl_s
+        )
+        while self.session_max and len(self._sessions) > self.session_max:
+            oldest = min(self._sessions, key=lambda s: self._sessions[s][1])
+            self._drop_session(oldest)
+        self.metrics.set_gauge("session_active", float(len(self._sessions)))
+
+    def _drop_session(self, session_id: str) -> None:
+        self._sessions.pop(session_id, None)
+        release = getattr(self.queue.engine, "release_session", None) \
+            if hasattr(self.queue, "engine") else None
+        if release is not None:
+            release(session_id)
+        self.metrics.set_gauge("session_active", float(len(self._sessions)))
 
     @traced_grpc_handler("tutoring.GetLLMAnswer")
     async def GetLLMAnswer(self, request, context):
@@ -160,6 +212,126 @@ class TutoringService(rpc.TutoringServicer):
             )
         return lms_pb2.QueryResponse(success=True, response=answer.strip())
 
+    @traced_grpc_handler("tutoring.StreamLLMAnswer")
+    async def StreamLLMAnswer(self, request, context):
+        """Server-streaming tutoring answer (resumable-stream contract).
+
+        Chunk offsets count tokens and are monotone and gap-free;
+        `request.resume_offset = K` regenerates deterministically and
+        delivers only tokens >= K (the failover path: the pool resumes a
+        broken stream at the client's delivered offset instead of
+        restarting it). The final chunk carries the sha256 hexdigest of
+        the full *stripped* answer — byte-identical to what the unary
+        GetLLMAnswer would return — so resumed clients verify their
+        spliced transcript against it.
+
+        `request.session_id` makes the turn conversational: the prompt
+        extends this node's running transcript (turn N's prompt+answer),
+        and on completion the transcript is re-published so the radix
+        prefix cache serves turn N+1's shared prefix from cached KV.
+        """
+        self.metrics.inc("llm_requests")
+        if context is not None:
+            trailer = [(QUEUE_DEPTH_METADATA_KEY,
+                        str(self.queue.waiting))]
+            if self.node_id:
+                trailer.append((SERVED_BY_METADATA_KEY, self.node_id))
+            context.set_trailing_metadata(tuple(trailer))
+        if self.draining:
+            self.metrics.inc("tutoring_drain_rejections")
+            if context is not None:
+                await context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    "draining: this tutoring node is not admitting new "
+                    "work",
+                )
+            yield lms_pb2.StreamChunk(
+                success=False, final=True,
+                text="draining: this tutoring node is not admitting new "
+                "work",
+            )
+            return
+        if self.auth_key and not auth.verify_query(
+            self.auth_key, request.query, request.token
+        ):
+            self.metrics.inc("llm_unauthorized")
+            yield lms_pb2.StreamChunk(
+                success=False, final=True,
+                text="Unauthorized: query the LMS, not the tutoring node.",
+            )
+            return
+        if not request.query.strip():
+            yield lms_pb2.StreamChunk(success=False, final=True,
+                                      text="Empty query.")
+            return
+        deadline = Deadline.from_grpc_context(context)
+        if deadline is not None and deadline.expired:
+            self.metrics.inc("shed_expired")
+            await context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                "deadline already expired on arrival",
+            )
+        # Session turns extend the running transcript verbatim (byte-
+        # stable prefix => radix cache splices turn N's KV); fresh
+        # streams frame the query exactly like the unary path so
+        # stream-vs-unary answers are bit-identical.
+        session_id = request.session_id
+        transcript = self._session_transcript(session_id) if session_id \
+            else ""
+        if transcript:
+            prompt = transcript + FOLLOWUP_TEMPLATE.format(
+                query=request.query)
+        else:
+            prompt = PROMPT_TEMPLATE.format(query=request.query)
+        session = (session_id, self.session_ttl_s) if session_id else None
+        sent_any = False
+        try:
+            with self.metrics.time("answer_latency"):
+                async for delta in self.queue.submit_stream(
+                    prompt, deadline=deadline,
+                    span=get_tracer().current(),
+                    resume_offset=request.resume_offset,
+                    session=session,
+                ):
+                    self.metrics.inc("stream_chunks")
+                    if delta.final:
+                        full = delta.full_text
+                        if session_id:
+                            self._session_update(session_id, prompt + full)
+                        yield lms_pb2.StreamChunk(
+                            success=True, text=delta.text,
+                            offset=delta.offset, count=delta.count,
+                            final=True,
+                            digest=hashlib.sha256(
+                                full.strip().encode()).hexdigest(),
+                        )
+                    else:
+                        yield lms_pb2.StreamChunk(
+                            success=True, text=delta.text,
+                            offset=delta.offset, count=delta.count,
+                        )
+                    sent_any = True
+        except Overloaded as e:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except DeadlineExpired as e:
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("streamed generation failed")
+            self.metrics.inc("llm_failures")
+            if not sent_any:
+                # No byte delivered yet: fail softly like the unary path.
+                yield lms_pb2.StreamChunk(
+                    success=False, final=True,
+                    text="The tutoring model is unavailable.",
+                )
+            elif context is not None:
+                # Mid-stream: delivered text can't be retracted — surface
+                # a hard error so the pool resumes at the client's offset.
+                await context.abort(grpc.StatusCode.INTERNAL,
+                                    "stream broken mid-answer")
+
 
 async def _report_metrics(metrics: Metrics, period_s: float) -> None:
     while True:
@@ -223,6 +395,10 @@ def make_tutoring_health(service: TutoringService, queue,
             # Drain lifecycle: true while this node refuses new work and
             # finishes what it holds; the router ejects it meanwhile.
             "draining": service.draining,
+            # Live multi-turn tutoring sessions held on this node (stream
+            # path; transcripts + prefix-cache pins expire on [sessions]
+            # ttl_s).
+            "sessions": len(service._sessions),
         }
         if scorer is not None:
             # Background-tenant surface: backlog/quanta/completed at a
@@ -253,6 +429,8 @@ async def serve_async(
     scoring_max_job_texts: int = 4096,
     scoring_jobs_retained: int = 32,
     scoring_chip_ceiling: float = 61500.0,
+    session_ttl_s: float = 600.0,
+    session_max: int = 256,
 ) -> grpc.aio.Server:
     """Start (and return) the aio server; caller awaits termination.
 
@@ -290,7 +468,9 @@ async def serve_async(
         ]
     )
     service = TutoringService(queue, metrics, auth_key=auth_key,
-                              node_id=node_id)
+                              node_id=node_id,
+                              session_ttl_s=session_ttl_s,
+                              session_max=session_max)
     rpc.add_TutoringServicer_to_server(service, server)
     server._port = server.add_insecure_port(f"[::]:{port}")
     await server.start()
@@ -553,6 +733,8 @@ def main(argv=None) -> None:
             "telemetry_ring": cfg.telemetry.ring_points,
         }, argv=argv)
         args.scoring_chip_ceiling = cfg.telemetry.chip_ceiling_tokens_per_s
+        args.session_ttl_s = cfg.sessions.ttl_s
+        args.session_max = cfg.sessions.max_sessions
         if not args.no_telemetry:
             args.telemetry = cfg.telemetry.enabled
         args.sampling_overrides = dict(
@@ -567,6 +749,8 @@ def main(argv=None) -> None:
     else:
         args.sampling_overrides = {}
         args.scoring_chip_ceiling = 61500.0
+        args.session_ttl_s = 600.0
+        args.session_max = 256
     if args.jax_platform == "cpu":
         import jax
 
@@ -659,6 +843,8 @@ def main(argv=None) -> None:
             scoring_max_job_texts=args.scoring_max_job_texts,
             scoring_jobs_retained=args.scoring_jobs_retained,
             scoring_chip_ceiling=args.scoring_chip_ceiling,
+            session_ttl_s=args.session_ttl_s,
+            session_max=args.session_max,
         )
         await server.wait_for_termination()
 

@@ -679,7 +679,9 @@ class SimCluster:
                                   scorer=scorer)
         await queue.start()
         server = grpc.aio.server()
-        service = TutoringService(queue, metrics, node_id=f"tut{idx}")
+        service = TutoringService(queue, metrics, node_id=f"tut{idx}",
+                                  session_ttl_s=self.cfg.session_ttl_s,
+                                  session_max=64)
         rpc.add_TutoringServicer_to_server(service, server)
         with self._lock:
             want = self._tutoring_addrs.get(idx)
@@ -780,6 +782,7 @@ class SimCluster:
             timeout_s=min(30.0, cfg.llm_budget_s),
             deadline_floor_s=0.25,
             hedge_after_s=0.1,
+            stream_stall_s=1.0,
             queue_spill_depth=16,
             warmup_s=1.0,
             health_poll_s=0.2,

@@ -44,6 +44,12 @@ MEMBERSHIP_REMOVE = "membership_remove"
 TUTORING_BLACKOUT = "tutoring_blackout"
 TUTORING_DRAIN = "tutoring_drain_rejoin"
 TUTORING_AUTOSCALE = "tutoring_autoscale"
+# Resumable-stream drill: inject a mid-stream loss (the chaos `error`
+# fault fires AFTER the first delivered chunk) on the streamed probe
+# session's affinity node — the router must resume the answer at the
+# delivered token offset on the spill node, and the client's digest
+# check proves no token was duplicated or dropped across the failover.
+TUTORING_STREAM_KILL = "tutoring_stream_kill"
 # Bulk grading night ([sim] bulk_scoring): an instructor-scale score job
 # fans every submitted assignment to the tutoring fleet's background
 # scoring tenant via the LMS admin plane, mid-run, while student traffic
@@ -70,6 +76,10 @@ NON_FAULT_KINDS = frozenset({BULK_GRADING})
 # so a probe's hedge/spill is guaranteed to exercise the router (the
 # harness's asker issues this same query).
 PROBE_QUERY = "ops bot probe: what is Raft?"
+# The streamed probe's session id: every streamer call converses in this
+# one session, so the stream-kill drill can resolve (and fault) the node
+# holding its transcript via /admin/tutoring/route?session=.
+STREAM_SESSION_ID = "ops-bot-stream-drill"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,7 +177,12 @@ def plan_events(cfg: SimConfig) -> List[SimEvent]:
                     "delay_s": 0.6,
                 },
             ),
-            SimEvent(at_s=_jitter(rng, 0.64, 0.02) * T,
+            SimEvent(
+                at_s=_jitter(rng, 0.58, 0.02) * T,
+                kind=TUTORING_STREAM_KILL,
+                params={"error_s": round(max(1.2, 0.06 * T), 3)},
+            ),
+            SimEvent(at_s=_jitter(rng, 0.68, 0.02) * T,
                      kind=TUTORING_DRAIN, params={}),
             SimEvent(at_s=_jitter(rng, 0.84, 0.02) * T,
                      kind=TUTORING_AUTOSCALE,
@@ -187,12 +202,16 @@ class OperationsScheduler:
     """
 
     def __init__(self, cluster, plan: List[SimEvent], *, metrics=None,
-                 writer=None, asker=None, ledger=None):
+                 writer=None, asker=None, streamer=None, ledger=None):
         self.cluster = cluster
         self.plan = sorted(plan, key=lambda e: e.at_s)
         self.metrics = metrics
         self.writer = writer
         self.asker = asker
+        # One STREAMED probe over a fixed session id (STREAM_SESSION_ID);
+        # returns True when the stream completed digest-intact. The
+        # stream-kill drill drives it under an injected mid-stream loss.
+        self.streamer = streamer
         self.ledger = ledger
         self.outcomes: List[Dict] = []   # guarded-by: _lock
         self._lock = threading.Lock()
@@ -258,6 +277,7 @@ class OperationsScheduler:
                     TUTORING_BLACKOUT: self._tutoring_blackout,
                     TUTORING_DRAIN: self._tutoring_drain,
                     TUTORING_AUTOSCALE: self._tutoring_autoscale,
+                    TUTORING_STREAM_KILL: self._tutoring_stream_kill,
                     BULK_GRADING: self._bulk_grading,
                     GROUP_LEADER_LOSS: self._group_leader_loss,
                     GROUP_SPLIT: self._group_split,
@@ -612,13 +632,16 @@ class OperationsScheduler:
 
     # ------------------------------------------------------ fleet drills
 
-    def _probe_route(self, nid: int) -> Dict:
+    def _probe_route(self, nid: int, session_id: str = "") -> Dict:
         """Where the ring on LMS node `nid` would send the ops bot's
-        probe query (GET /admin/tutoring/route)."""
-        doc = self.cluster.admin_get(
-            nid,
-            "/admin/tutoring/route?q=" + urllib.parse.quote(PROBE_QUERY),
-        )
+        probe query (GET /admin/tutoring/route) — or, with `session_id`,
+        the probe SESSION's sticky key (the node holding its transcript
+        and pinned prefix blocks)."""
+        path = ("/admin/tutoring/route?session="
+                + urllib.parse.quote(session_id) if session_id else
+                "/admin/tutoring/route?q="
+                + urllib.parse.quote(PROBE_QUERY))
+        doc = self.cluster.admin_get(nid, path)
         if not doc.get("order"):
             raise RuntimeError(f"empty tutoring route on node {nid}: "
                                f"{doc}")
@@ -702,13 +725,18 @@ class OperationsScheduler:
                 "router spilled within the outage window")
 
     def _tutoring_drain(self, event: SimEvent) -> str:
-        """Elastic drain-and-rejoin: POST /admin/drain on the probe's
-        affinity node, watch the router eject it (health poller), keep
-        serving via the second choice, end the drain, and verify the
-        ring routes the probe key BACK to the node once its warm-up
-        ramp finishes — cache affinity restored, not just liveness."""
+        """Elastic drain-and-rejoin, MID-SESSION: POST /admin/drain on
+        the streamed probe session's affinity node (the one holding its
+        transcript), watch the router eject it (health poller), prove
+        the session's next streamed turn completes on the second choice
+        (correctness never depends on node-local session warmth), end
+        the drain, and verify the ring routes the session key BACK to
+        the node once its warm-up ramp finishes — cache affinity
+        restored, not just liveness."""
         leader = self._leader()
-        route = self._probe_route(leader)
+        if self.streamer is not None:
+            self.streamer()  # seed the session so a transcript is live
+        route = self._probe_route(leader, session_id=STREAM_SESSION_ID)
         idx = route["order"][0]["index"]
         address = route["order"][0]["address"]
         self.cluster.tutoring_admin_post(idx, "/admin/drain",
@@ -722,10 +750,16 @@ class OperationsScheduler:
                    10.0, f"router ejected {address}")
         if self.asker is not None:
             self.asker()  # served by the second choice while drained
-        mid = self._probe_route(self._leader())
+        mid = self._probe_route(self._leader(),
+                                session_id=STREAM_SESSION_ID)
         if mid["order"] and mid["order"][0]["index"] == idx:
             raise RuntimeError(
-                f"probe still routed to draining node {idx}: {mid}"
+                f"session still routed to draining node {idx}: {mid}"
+            )
+        if self.streamer is not None and not self.streamer():
+            raise RuntimeError(
+                "streamed session turn failed while its affinity node "
+                f"{idx} drained (must be served off-node)"
             )
         self.cluster.tutoring_admin_post(idx, "/admin/drain",
                                          {"drain": False})
@@ -734,15 +768,70 @@ class OperationsScheduler:
                    10.0, f"router re-admitted {address}")
         self._wait(lambda: self._fleet_state(leader, address) == "ok",
                    10.0, f"warm-up of {address} finished")
-        back = self._probe_route(leader)
+        back = self._probe_route(leader, session_id=STREAM_SESSION_ID)
         if back["order"][0]["index"] != idx:
             raise RuntimeError(
-                f"affinity not restored after rejoin: probe routes to "
+                f"affinity not restored after rejoin: session routes to "
                 f"{back['order'][0]} instead of node {idx}"
             )
-        return (f"drained tutoring:{idx} (router ejected it, traffic "
-                "spilled), rejoined with warm-up; probe affinity "
-                "restored to the same node")
+        return (f"drained tutoring:{idx} mid-session (router ejected "
+                "it, the session's streamed turn completed off-node), "
+                "rejoined with warm-up; session affinity restored to "
+                "the same node")
+
+    def _tutoring_stream_kill(self, event: SimEvent) -> str:
+        """Kill-mid-stream: the chaos `error` fault on the session's
+        affinity node makes every stream from it die right AFTER its
+        first delivered chunk — too late to hedge (hedging is
+        before-first-byte only), so the router must resume the answer
+        at the delivered token offset on the spill node. Evidence is
+        demanded from both ends: the fleet's stream_resumes counter
+        moves, and the client completes a streamed answer whose
+        assembled text matches the final chunk's digest (no token
+        duplicated or dropped across the failover)."""
+        p = event.params
+        if self.streamer is None:
+            raise RuntimeError("stream-kill drill needs a streamer probe")
+        leader = self._leader()
+        self.streamer()  # seed the session (affinity + transcript)
+        route = self._probe_route(leader, session_id=STREAM_SESSION_ID)
+        if len(route["order"]) < 2:
+            raise RuntimeError(
+                f"stream-kill drill needs a spill candidate: {route}"
+            )
+        idx = route["order"][0]["index"]
+        resumes0 = self._fleet_counter(metric.STREAM_RESUMES)
+        self.cluster.admin_post(leader, "/admin/faults", {"campaign": {
+            "name": "sim-stream-kill",
+            "phases": [{"target": f"tutoring:{idx}",
+                        "duration_s": p["error_s"], "error": 1.0}],
+        }})
+        t0 = time.monotonic()
+        end = t0 + p["error_s"]
+        intact = 0
+        resumes = resumes0
+        while time.monotonic() < end - 0.1:
+            if self.streamer():
+                intact += 1
+            resumes = self._fleet_counter(metric.STREAM_RESUMES)
+            if resumes > resumes0 and intact >= 1:
+                break
+            time.sleep(0.05)
+        time.sleep(max(0.0, end - time.monotonic()))
+        if resumes <= resumes0:
+            raise RuntimeError(
+                f"no resume-at-offset failover during the "
+                f"{p['error_s']}s mid-stream loss on tutoring:{idx}"
+            )
+        if intact < 1:
+            raise RuntimeError(
+                "no digest-intact streamed answer completed during the "
+                f"mid-stream loss on tutoring:{idx}"
+            )
+        return (f"injected mid-stream loss on tutoring:{idx} for "
+                f"{p['error_s']}s; +{resumes - resumes0} resume-at-"
+                f"offset failovers, {intact} streamed answer(s) "
+                "completed digest-intact")
 
     def _tutoring_autoscale(self, event: SimEvent) -> str:
         """Autoscaling drill: add a fleet member under load (every LMS

@@ -191,7 +191,7 @@ class SemesterSim:
             scheduler = ev.OperationsScheduler(
                 self.cluster, plan, metrics=self.metrics,
                 writer=self._bot_write, asker=self._bot_ask,
-                ledger=self.ledger,
+                streamer=self._bot_stream, ledger=self.ledger,
             )
             t0 = time.monotonic()
             telemetry: Optional[_TelemetryLoop] = None
@@ -411,6 +411,32 @@ class SemesterSim:
             return True
         return False
 
+    def _bot_stream(self) -> bool:
+        """One STREAMED ask_llm probe riding a fixed session id (the
+        stream-kill drill faults this session's affinity node mid-answer,
+        so the probe's resume-at-offset failover is guaranteed to
+        exercise the router); True if the stream completed with its
+        digest intact."""
+        try:
+            ans = self._ops_bot.ask_llm_stream(
+                ev.PROBE_QUERY, session_id=ev.STREAM_SESSION_ID,
+                budget_s=4.0,
+            )
+        except _CLIENT_ERRORS as e:
+            log.info("ops bot stream failed: %s", e)
+            return False
+        if ans.resumes:
+            self.metrics.inc(metric.SIM_STREAM_RESUMES, ans.resumes)
+        if ans.digest_ok is False:
+            self.metrics.inc(metric.SIM_STREAM_DIGEST_MISMATCH)
+            return False
+        if _is_degraded(ans):
+            self.metrics.inc(metric.SIM_DEGRADED_ANSWERS)
+            self.ledger.record(QUERY, ("ops_bot",), ev.PROBE_QUERY,
+                               group=self._group_tag("ops_bot"))
+            return False
+        return bool(ans.success)
+
     # -------------------------------------------------------------- workload
 
     def _start_workers(self, ops: List[wl.SimOp],
@@ -511,6 +537,8 @@ class SemesterSim:
                                    group=self._group_tag(op.actor))
             elif not resp.success:
                 raise SimOpFailed(f"ask_llm refused: {resp.response[:80]}")
+        elif kind == wl.ASK_LLM_SESSION_CHAIN:
+            self._run_session_chain(c, op)
         elif kind == wl.DOWNLOAD_MATERIAL:
             t1 = time.monotonic()
             entries = c.course_materials()
@@ -527,6 +555,47 @@ class SemesterSim:
             self.ledger.check_responses_read(t1, texts, op.actor)
         else:  # pragma: no cover - generator and executor share the enum
             raise ValueError(f"unknown op kind {kind!r}")
+
+    def _run_session_chain(self, c: LMSClient, op: wl.SimOp) -> None:
+        """One conversational session, end to end: every turn streams
+        over the SAME session id (sticky affinity, transcript splice on
+        the serving node), TTFT is recorded per turn, and the final
+        chunk's digest check catches any duplicated/dropped token. A
+        terminally failed turn abandons the rest of the chain — later
+        turns converse against the transcript the failed turn never
+        produced."""
+        sid = op.payload["session"]
+        for turn, query in enumerate(op.payload["queries"].split("\x1f"),
+                                     start=1):
+            t1 = time.monotonic()
+            try:
+                ans = c.ask_llm_stream(query, session_id=sid,
+                                       budget_s=self.cfg.llm_budget_s)
+            except _CLIENT_ERRORS as e:
+                log.info("session %s turn %d failed: %s", sid, turn, e)
+                self.metrics.inc(metric.SIM_SESSION_TURNS_FAILED)
+                return
+            finally:
+                self.metrics.hist(metric.SIM_ASK_LATENCY).observe(
+                    time.monotonic() - t1
+                )
+            self.metrics.inc(metric.SIM_SESSION_TURNS)
+            if ans.ttft_s is not None:
+                self.metrics.hist(metric.SIM_TURN_TTFT).observe(ans.ttft_s)
+            if ans.resumes:
+                self.metrics.inc(metric.SIM_STREAM_RESUMES, ans.resumes)
+            if ans.digest_ok is False:
+                self.metrics.inc(metric.SIM_STREAM_DIGEST_MISMATCH)
+            if _is_degraded(ans):
+                # Same contract as the unary path: a degraded answer IS
+                # a write onto the replicated instructor queue.
+                self.metrics.inc(metric.SIM_DEGRADED_ANSWERS)
+                self.ledger.record(QUERY, (op.actor,), query,
+                                   group=self._group_tag(op.actor))
+            elif not ans.success:
+                raise SimOpFailed(
+                    f"session turn refused: {ans.response[:80]}"
+                )
 
     # ---------------------------------------------------------------- settle
 
@@ -646,6 +715,11 @@ class SemesterSim:
             "hedge_wins": total(metric.TUTORING_HEDGE_WINS),
             "ejections": total(metric.TUTORING_NODE_EJECTIONS),
             "rejoins": total(metric.TUTORING_NODE_REJOINS),
+            # Resumable-stream evidence: router-side resume-at-offset
+            # failovers and per-chunk stall trips (the stream-kill drill
+            # must leave >= 1 resume behind).
+            "stream_resumes": total(metric.STREAM_RESUMES),
+            "stream_stalls": total(metric.STREAM_STALLS),
             "nodes": nodes,
         }
 
@@ -816,6 +890,20 @@ class SemesterSim:
             "ops_failed": counters.get("sim_ops_failed", 0),
             "ops_dropped": counters.get("sim_ops_dropped", 0),
             "asks": ask.get("count", 0),
+            # Conversational/streaming evidence: completed streamed
+            # turns, their TTFT distribution, client-observed
+            # resume-at-offset failovers, and digest mismatches (must be
+            # 0 — also a verdict check).
+            "sessions": {
+                "turns_ok": counters.get("sim_session_turns", 0),
+                "turns_failed": counters.get("sim_session_turns_failed",
+                                             0),
+                "turn_ttft": snap_hist(snap, metric.SIM_TURN_TTFT),
+                "stream_resumes": counters.get("sim_stream_resumes", 0),
+                "digest_mismatches": counters.get(
+                    "sim_stream_digest_mismatch", 0
+                ),
+            },
             "degraded_answers": counters.get("sim_degraded_answers", 0),
             "gate_pass": gate_pass,
             "gate_reject": gate_reject,

@@ -445,6 +445,42 @@ def evaluate_slos(
           f"{len(failed)} failed" + (f": {failed[:3]}" if failed else ""),
           "every planned event ok")
 
+    # Resumable-stream / conversational-session verdicts. The digest
+    # check is unconditional: the client verifies every completed stream
+    # against the final chunk's answer digest, so ANY duplicated or
+    # dropped token — including across a mid-stream failover — lands in
+    # this counter (0 streams trivially passes).
+    mismatches = snap_counter(sim_metrics, metric.SIM_STREAM_DIGEST_MISMATCH)
+    streamed_turns = snap_counter(sim_metrics, metric.SIM_SESSION_TURNS)
+    check("stream_digest_parity", mismatches == 0,
+          f"{mismatches} digest mismatches over {streamed_turns} "
+          "streamed turns",
+          "0 — streams monotone, gap-free, duplicate-free")
+    if round(cfg.session_fraction * cfg.students) >= 1:
+        turns_failed = snap_counter(sim_metrics,
+                                    metric.SIM_SESSION_TURNS_FAILED)
+        check("session_turns_completed", streamed_turns >= 1,
+              f"{streamed_turns} ok / {turns_failed} failed",
+              ">= 1 streamed session turn completed")
+        tt = snap_hist(sim_metrics, metric.SIM_TURN_TTFT)
+        ttft_p95 = tt.get("p95_s")
+        check(
+            "turn_ttft_p95",
+            ttft_p95 is None or ttft_p95 <= cfg.slo_turn_ttft_p95_s,
+            f"{ttft_p95 if ttft_p95 is not None else 'n/a'} s "
+            f"({tt.get('count', 0)} turns)",
+            f"<= {cfg.slo_turn_ttft_p95_s} s",
+        )
+        if cfg.tutoring_engine == "tiny-paged" and streamed_turns >= 2:
+            # Follow-up turns must actually splice the session prefix:
+            # turn N+1 starts from turn N's published transcript blocks,
+            # so the radix cache records hit tokens (> 0) for the chain.
+            hit_tokens = snap_counter(tutoring_metrics or {},
+                                      metric.PREFIX_CACHE_HIT_TOKENS)
+            check("session_prefix_hits", hit_tokens > 0,
+                  f"{hit_tokens} prefix-cache hit tokens",
+                  "> 0 hit tokens across follow-up turns")
+
     if continuous is not None:
         evaluated = continuous.get("windows_evaluated", {})
         missing = [slo for slo in CONTINUOUS_SLOS
@@ -480,6 +516,11 @@ def evaluate_slos(
                   f"{fleet.get('hedge_wins', 0)} hedge wins "
                   f"({fleet.get('hedges', 0)} hedged)",
                   ">= 1 hedged answer won")
+            check("stream_resume_observed",
+                  fleet.get("stream_resumes", 0) >= 1,
+                  f"{fleet.get('stream_resumes', 0)} resumes "
+                  f"({fleet.get('stream_stalls', 0)} stall trips)",
+                  ">= 1 mid-stream failover resumed at its offset")
         stuck_nodes = [n["address"] for n in fleet.get("nodes", ())
                        if n.get("state") in ("draining", "ejected")]
         check("fleet_nodes_routable", not stuck_nodes,

@@ -25,6 +25,7 @@ from typing import Dict, List, Tuple
 from ..config import SimConfig
 
 # Op kinds, student-issued unless noted.
+ASK_LLM_SESSION_CHAIN = "ask_llm_session_chain"
 DOWNLOAD_MATERIAL = "download_material"
 SUBMIT_ASSIGNMENT = "submit_assignment"
 ASK_LLM_ON_TOPIC = "ask_llm_on_topic"
@@ -62,6 +63,15 @@ OFF_TOPIC_QUERIES = (
     "What is the best pizza topping?",
     "Who won the world cup in 1998?",
     "Write me a poem about the sea.",
+)
+# Follow-up turns of a streamed tutoring session: each rides the SAME
+# session id, so the server splices the prior turns' transcript as the
+# shared prompt prefix (session-pinned radix blocks).
+FOLLOWUP_QUERIES = (
+    "Can you elaborate on that point?",
+    "What happens in the failure case?",
+    "How does that interact with snapshots?",
+    "Give a concrete example of that.",
 )
 ASSIGNMENT_TEXT = (
     "Homework: explain the Raft consensus algorithm - leader election, "
@@ -171,7 +181,43 @@ class WorkloadGenerator:
                 continue  # thinned: below the diurnal envelope right now
             kind = rng.choices(kinds, weights=weights, k=1)[0]
             ops.append(self._op(kind, t, rng, counters))
+        ops.extend(self._session_chains())
+        ops.sort(key=lambda op: (op.at_s, op.actor, op.kind))
         return ops
+
+    def _session_chains(self) -> List[SimOp]:
+        """Conversational follow-up chains: `session_fraction` of the
+        students each run ONE multi-turn streamed session (one op — the
+        executor drives the turns sequentially, since turn N+1 needs
+        turn N's transcript on the server). A separate seeded RNG stream
+        keeps the Poisson trace untouched by the chain knobs."""
+        cfg = self.cfg
+        n = min(len(self.students),
+                round(cfg.session_fraction * len(self.students)))
+        if n <= 0 or cfg.session_turns < 1:
+            return []
+        srng = random.Random(cfg.seed ^ 0x5E5510)
+        chains: List[SimOp] = []
+        for i in range(n):
+            actor = self.students[i * len(self.students) // n]
+            course = self.course_of(actor)
+            first = srng.choice(ON_TOPIC_QUERIES)
+            if cfg.course_concentration > 0:
+                first = self.course_context(course) + first
+            queries = [first] + [
+                srng.choice(FOLLOWUP_QUERIES)
+                for _ in range(cfg.session_turns - 1)
+            ]
+            # Chains start in the first 60% of the run so every turn —
+            # each bounded by llm_budget_s — can finish inside it.
+            at = srng.uniform(0.05, 0.60) * cfg.duration_s
+            chains.append(SimOp(
+                at_s=at, actor=actor, role="student",
+                kind=ASK_LLM_SESSION_CHAIN, course=course,
+                payload={"session": f"{actor}-chain{i}",
+                         "queries": "\x1f".join(queries)},
+            ))
+        return chains
 
     # ------------------------------------------------------------ builders
 

@@ -202,6 +202,19 @@ TUTORING_FLEET_SIZE = gauge(
     "tutoring_fleet_size",
     "routable tutoring fleet members (configured minus ejected/draining)",
 )
+STREAM_RESUMES = counter(
+    "stream_resumes",
+    "streamed answers resumed at the client's delivered token offset on "
+    "another fleet node after the serving stream broke mid-answer (node "
+    "death, open breaker, drain, or a per-chunk stall) — the "
+    "resumable-stream contract's failover path; never a restart",
+)
+STREAM_STALLS = counter(
+    "stream_stalls",
+    "streamed forwards declared wedged because no chunk arrived within "
+    "stream_stall_s (the stream was open but silent); each counts "
+    "against the node's breaker and triggers a resume-at-offset",
+)
 
 # Breaker state -> transition counter, used by the LMS breaker observer.
 # Living HERE keeps the mapping inside the declared namespace: the lint
@@ -283,6 +296,22 @@ TUTORING_DRAIN_REJECTIONS = counter(
     "tutoring_drain_rejections",
     "requests refused because this tutoring node was draining (the "
     "router spills them to another fleet member)",
+)
+STREAM_CHUNKS = counter(
+    "stream_chunks",
+    "StreamLLMAnswer chunks sent (LMS leader and tutoring node each "
+    "count their own side of the stream)",
+)
+SESSION_ACTIVE = gauge(
+    "session_active",
+    "live multi-turn tutoring sessions this node holds transcripts for "
+    "([sessions] ttl_s expiry, max_sessions cap)",
+)
+SESSION_PINNED_BLOCKS = gauge(
+    "session_pinned_blocks",
+    "shared-prefix KV blocks held resident by live session pins (soft "
+    "pins: TTL-expired first under eviction pressure, then "
+    "soonest-expiry live pins — hard refcount pins are never evicted)",
 )
 SHED_EXPIRED = counter(
     "shed_expired",
@@ -598,6 +627,33 @@ SIM_BURN_ALERTS = counter(
     "burn-rate alerts the continuous SLO engine raised during the run "
     "(fast- and slow-window; each is also recorded as a timeline event "
     "and classified against the injected-fault phases in the verdict)",
+)
+SIM_SESSION_TURNS = counter(
+    "sim_session_turns",
+    "streamed follow-up-chain turns the simulated students completed "
+    "(each is one StreamLLMAnswer call carrying a session id)",
+)
+SIM_SESSION_TURNS_FAILED = counter(
+    "sim_session_turns_failed",
+    "streamed session turns that failed terminally; the rest of that "
+    "chain is abandoned (later turns need the transcript)",
+)
+SIM_STREAM_RESUMES = counter(
+    "sim_stream_resumes",
+    "client-observed resume-at-offset failovers: streamed asks that "
+    "lost their stream after the first delivered byte and continued "
+    "from the delivered token offset on a retry",
+)
+SIM_STREAM_DIGEST_MISMATCH = counter(
+    "sim_stream_digest_mismatch",
+    "streamed answers whose assembled text failed the final chunk's "
+    "digest check — a duplicated or dropped token somewhere in the "
+    "stream; the verdict requires 0",
+)
+SIM_TURN_TTFT = histogram(
+    "sim_turn_ttft",
+    "client-observed time to first streamed token per session turn "
+    "(its p95 is the per-turn conversational SLO)",
 )
 
 # Raft runner (utils/guards.py LoopWatchdog wired by lms/node.py).

@@ -40,6 +40,7 @@ import contextlib
 import contextvars
 import copy
 import functools
+import inspect
 import random
 import threading
 import time
@@ -354,7 +355,17 @@ class Tracer:
             span.flag(FLAG_ERROR)
             raise
         finally:
-            self._current.reset(token)
+            try:
+                self._current.reset(token)
+            except ValueError:
+                # Spans opened inside an async generator can be entered
+                # from one task and unwound from another (a hedged first
+                # read advances the generator in the race task; the
+                # caller's task closes it).  The entering task's context
+                # copy dies with that task, so there is nothing to
+                # restore here — and the original exception must keep
+                # propagating untouched.
+                pass
             span.end()
 
     def continue_from_grpc_context(
@@ -672,6 +683,19 @@ def traced_grpc_handler(name: str) -> Callable:
     present) for the duration of the handler."""
 
     def deco(fn: Callable) -> Callable:
+        if inspect.isasyncgenfunction(fn):
+            # Server-streaming handler: the span must stay open across
+            # every yield (the fragment covers first chunk through final),
+            # so the wrapper is itself an async generator.
+            @functools.wraps(fn)
+            async def gen_wrapper(self: Any, request: Any,
+                                  context: Any) -> Any:
+                with get_tracer().continue_from_grpc_context(context, name):
+                    async for item in fn(self, request, context):
+                        yield item
+
+            return gen_wrapper
+
         @functools.wraps(fn)
         async def wrapper(self: Any, request: Any, context: Any) -> Any:
             with get_tracer().continue_from_grpc_context(context, name):

@@ -75,6 +75,14 @@ _DASH_ROWS: Tuple[Tuple[str, str, str], ...] = (
     ("router spills/s", "rate", "tutoring_spills"),
     ("hedge wins/s", "rate", "tutoring_hedge_wins"),
     ("fleet size", "gauge", "tutoring_fleet_size"),
+    # Streaming/session plane: chunk throughput, resume-at-offset
+    # failovers and stall trips (both should be ~0 outside faults), and
+    # the live conversational state pinned on the fleet.
+    ("stream chunks/s", "rate", "stream_chunks"),
+    ("stream resumes/s", "rate", "stream_resumes"),
+    ("stream stalls/s", "rate", "stream_stalls"),
+    ("sessions live", "gauge", "session_active"),
+    ("session pins", "gauge", "session_pinned_blocks"),
     ("answer p95 (s)", "p95", "answer_latency"),
     ("llm_ttft p95 (s)", "p95", "llm_ttft"),
     ("ttft p95 (s)", "p95", "ttft"),
@@ -160,7 +168,8 @@ def render_dashboard(scraper: ClusterScraper, window_s: float,
     # cold rejoined node refilling its cache.
     if len(scraper.nodes) > 1:
         out.write(f"  {'node':<14} {'req/s':>7} {'queue':>7} "
-                  f"{'tok/s':>7} {'hit':>7} {'p95 s':>7}\n")
+                  f"{'tok/s':>7} {'hit':>7} {'strm/s':>7} "
+                  f"{'sess':>7} {'pins':>7} {'p95 s':>7}\n")
         for name in sorted(scraper.nodes):
             ntl = scraper.nodes[name]
             out.write(
@@ -169,6 +178,9 @@ def render_dashboard(scraper: ClusterScraper, window_s: float,
                 f" {_fmt(ntl.gauge_last('serving_queue_depth'))}"
                 f" {_fmt(ntl.gauge_last('serving_tokens_per_s'))}"
                 f" {_fmt(ntl.gauge_last('prefix_cache_hit_rate'))}"
+                f" {_fmt(ntl.counter_rate('stream_chunks', window_s))}"
+                f" {_fmt(ntl.gauge_last('session_active'))}"
+                f" {_fmt(ntl.gauge_last('session_pinned_blocks'))}"
                 f" {_fmt(ntl.hist_p95('answer_latency', window_s))}\n"
             )
     events = tl.events()

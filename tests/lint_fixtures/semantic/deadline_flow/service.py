@@ -32,6 +32,30 @@ class FooServicer(rpc.FooServicer):  # noqa: F821 - fixture, never imported
         # asyncio.wait_for is not a gRPC stub call (snake_case).
         return await asyncio.wait_for(self.queue.get(), timeout=5)
 
+    async def StreamLiteralTimeout(self, request, context):
+        # Server-streaming egress as an async-for iterable: a literal
+        # timeout drops the budget exactly like the unary shape.
+        async for chunk in self.stub.StreamThing(request, timeout=5):  # EXPECT: deadline-flow
+            yield chunk
+
+    async def StreamNoTimeout(self, request, context):
+        # No timeout at all: the open stream outlives any client budget.
+        async for chunk in self.stub.StreamThing(request):  # EXPECT: deadline-flow
+            yield chunk
+
+    async def GoodStreamDerived(self, request, context):
+        deadline = Deadline.from_grpc_context(context)  # noqa: F821
+        # Budget-derived stream timeout: the fix shape, never flagged.
+        async for chunk in self.stub.StreamThing(
+            request, timeout=deadline.timeout(cap=5.0)
+        ):
+            yield chunk
+
+    async def AsyncForHelpersAreNotEgress(self, request, context):
+        # snake_case async iterables (the engine queue) are not wire RPCs.
+        async for delta in self.queue.submit_stream(request):
+            yield delta
+
     async def Sanctioned(self, request, context):
         # A deliberate fixed-latency probe, visibly suppressed.
         return await self.stub.Probe(request, timeout=1)  # lint: disable=deadline-flow

@@ -48,6 +48,31 @@ class FooServicer(rpc.FooServicer):  # noqa: F821 - fixture, never imported
         # asyncio.wait_for is not a gRPC stub call (snake_case).
         return await asyncio.wait_for(self.queue.get(), timeout=5)
 
+    async def StreamNoMetadata(self, request, context):
+        # Server-streaming egress as an async-for iterable: even without
+        # a timeout= keyword (which the awaited-later shape relies on),
+        # the iteration context marks this as a wire RPC.
+        async for chunk in self.stub.StreamThing(request):  # EXPECT: trace-propagation
+            yield chunk
+
+    async def StreamBareMetadata(self, request, context):
+        async for chunk in self.stub.StreamThing(  # EXPECT: trace-propagation
+            request, metadata=deadline.to_metadata()  # noqa: F821
+        ):
+            yield chunk
+
+    async def GoodStreamWrapped(self, request, context):
+        # The streaming fix shape: wrapped metadata, never flagged.
+        async for chunk in self.stub.StreamThing(
+            request, metadata=trace_metadata()
+        ):
+            yield chunk
+
+    async def AsyncForHelpersAreNotEgress(self, request, context):
+        # snake_case async iterables (the engine queue) are not wire RPCs.
+        async for delta in self.queue.submit_stream(request):
+            yield delta
+
     async def Sanctioned(self, request, context):
         # A deliberately untraced probe, visibly suppressed.
         return await self.stub.Probe(request)  # lint: disable=trace-propagation

@@ -160,7 +160,10 @@ def test_bare_metadata_egress_fails_lint():
     (lms/tutoring_pool.py) — the pool is an egress-root module, so the
     same revert fails lint there."""
     project = _project_with_patch(
-        POOL, ("metadata=trace_metadata(md),", "metadata=md,")
+        POOL, (
+            "\n                    metadata=trace_metadata(md),",
+            "\n                    metadata=md,",
+        )
     )
     findings = [
         f for f in TracePropagationRule().check_project(project)
@@ -245,6 +248,48 @@ def test_router_metadata_bypass_fails_lint():
     assert findings, (
         "a router egress whose metadata bypasses trace_metadata() must "
         "fail trace-propagation"
+    )
+
+
+def test_stream_forward_metadata_drop_fails_lint():
+    """PR 20 acceptance pin: the router's server-streaming forward is
+    held to the same trace contract as its unary forwards — the
+    async-for egress shape. Stripping trace_metadata() off the
+    StreamLLMAnswer forward (what reverting the streaming sweep would
+    do) must fail trace-propagation, and dropping its timeout must fail
+    deadline-flow even though the call is never awaited directly."""
+    project = _project_with_patch(ROUTER, (
+        "stub.StreamLLMAnswer(\n"
+        "                request, timeout=timeout, "
+        "metadata=trace_metadata(md)\n"
+        "            )",
+        "stub.StreamLLMAnswer(\n"
+        "                request, timeout=timeout, metadata=md\n"
+        "            )",
+    ))
+    findings = [
+        f for f in TracePropagationRule().check_project(project)
+        if f.path == ROUTER and "StreamLLMAnswer" in f.message
+    ]
+    assert findings, (
+        "a metadata-dropping StreamLLMAnswer forward must fail "
+        "trace-propagation"
+    )
+    project = _project_with_patch(ROUTER, (
+        "stub.StreamLLMAnswer(\n"
+        "                request, timeout=timeout, "
+        "metadata=trace_metadata(md)\n"
+        "            )",
+        "stub.StreamLLMAnswer(\n"
+        "                request, metadata=trace_metadata(md)\n"
+        "            )",
+    ))
+    findings = [
+        f for f in DeadlineFlowRule().check_project(project)
+        if f.path == ROUTER and "StreamLLMAnswer" in f.message
+    ]
+    assert findings, (
+        "a timeout-less StreamLLMAnswer forward must fail deadline-flow"
     )
 
 

@@ -31,23 +31,34 @@ def test_frozen_contract_method_names():
         "RaftService",
         "Tutoring",
     ]
-    assert sorted(m.name for m in services["LMS"].methods) == sorted(
-        [
-            "Register",
-            "Login",
-            "Logout",
-            "Post",
-            "Get",
-            "GradeAssignment",
-            "GetGrade",
-            "GetLLMAnswer",
-            "GetUnansweredQueries",
-            "RespondToQuery",
-            "GetInstructorResponse",
-            "WhoIsLeader",
-        ]
-    )
-    assert [m.name for m in services["Tutoring"].methods] == ["GetLLMAnswer"]
+    lms_methods = {m.name for m in services["LMS"].methods}
+    lms_frozen = {
+        "Register",
+        "Login",
+        "Logout",
+        "Post",
+        "Get",
+        "GradeAssignment",
+        "GetGrade",
+        "GetLLMAnswer",
+        "GetUnansweredQueries",
+        "RespondToQuery",
+        "GetInstructorResponse",
+        "WhoIsLeader",
+    }
+    assert lms_methods >= lms_frozen
+    assert lms_methods - lms_frozen == {"StreamLLMAnswer"}
+    tutoring_methods = {m.name for m in services["Tutoring"].methods}
+    assert tutoring_methods >= {"GetLLMAnswer"}
+    assert tutoring_methods - {"GetLLMAnswer"} == {"StreamLLMAnswer"}
+    # The streaming additions are server-streaming (unary-stream) on both
+    # services, with identical request/response shapes.
+    for svc in ("LMS", "Tutoring"):
+        method = services[svc].methods_by_name["StreamLLMAnswer"]
+        assert method.server_streaming and not method.client_streaming
+        assert method.input_type.name == "StreamRequest"
+        assert method.output_type.name == "StreamChunk"
+        assert rpc._SERVICES[svc]["StreamLLMAnswer"][2] == "us"
     # Frozen = the reference surface never shrinks or renames; additive
     # methods (which old peers simply never call) are the sanctioned
     # extension mechanism. Assert superset + name the additions exactly, so
@@ -72,6 +83,19 @@ def test_frozen_contract_method_names():
 class _Raft(rpc.RaftServiceServicer):
     def WhoIsLeader(self, request, context):
         return lms_pb2.LeaderResponse(leader_id=3)
+
+
+class _StreamTutor(rpc.TutoringServicer):
+    def StreamLLMAnswer(self, request, context):
+        for i in range(request.resume_offset, 3):
+            yield lms_pb2.StreamChunk(
+                success=True,
+                text=f"tok{i} ",
+                offset=i,
+                count=1,
+                final=(i == 2),
+                digest="d" if i == 2 else "",
+            )
 
 
 class _Files(rpc.FileTransferServiceServicer):
@@ -107,6 +131,25 @@ def test_stream_unary_rpc_over_wire(live_server):
         )
         resp = stub.SendFile(chunks, timeout=5)
         assert resp.status == "success:30"
+
+
+def test_unary_stream_rpc_over_wire():
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=1))
+    rpc.add_TutoringServicer_to_server(_StreamTutor(), server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stub = rpc.TutoringStub(channel)
+            chunks = list(
+                stub.StreamLLMAnswer(
+                    lms_pb2.StreamRequest(query="q", resume_offset=1), timeout=5
+                )
+            )
+            assert [c.offset for c in chunks] == [1, 2]
+            assert chunks[-1].final and chunks[-1].digest == "d"
+    finally:
+        server.stop(grace=None)
 
 
 def test_unimplemented_method_raises():

@@ -77,7 +77,8 @@ def test_sim_executed_every_event_kind(sim_run):
     for kind in ("rolling_restart", "quarantine", "membership_add",
                  "membership_remove", "chaos_campaign",
                  "tutoring_blackout", "tutoring_drain_rejoin",
-                 "tutoring_autoscale", "bulk_grading_night"):
+                 "tutoring_autoscale", "bulk_grading_night",
+                 "tutoring_stream_kill"):
         assert executed.get(kind, 0) >= 1, f"missing event kind {kind}"
 
 
